@@ -1,0 +1,64 @@
+"""Test fixture builders, mirroring reference pkg/scheduler/util/test_utils.go."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from volcano_tpu.models import (
+    Node, Pod, PodGroup, PodGroupPhase, PodGroupSpec, PodGroupStatus,
+    Queue, QueueSpec,
+)
+from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+
+
+def build_resource_list(cpu: str = "0", memory: str = "0",
+                        **scalars) -> Dict[str, str]:
+    rl = {"cpu": cpu, "memory": memory}
+    rl.update({k.replace("__", "/").replace("_", "."): v
+               for k, v in scalars.items()})
+    return rl
+
+
+def build_pod(namespace: str, name: str, node_name: str, phase: str,
+              req: Dict[str, str], group_name: str = "",
+              labels: Optional[Dict[str, str]] = None,
+              node_selector: Optional[Dict[str, str]] = None,
+              priority: Optional[int] = None) -> Pod:
+    ann = {POD_GROUP_ANNOTATION: group_name} if group_name else {}
+    return Pod(
+        name=name, namespace=namespace, node_name=node_name, phase=phase,
+        annotations=ann, labels=labels or {},
+        node_selector=node_selector or {},
+        containers=[{"requests": dict(req)}],
+        priority=priority,
+    )
+
+
+def build_node(name: str, alloc: Dict[str, str],
+               labels: Optional[Dict[str, str]] = None,
+               pods: str = "110") -> Node:
+    rl = dict(alloc)
+    rl.setdefault("pods", pods)
+    return Node(name=name, labels=labels or {}, allocatable=rl, capacity=dict(rl))
+
+
+def build_pod_group(name: str, namespace: str = "default",
+                    min_member: int = 1, queue: str = "default",
+                    phase: PodGroupPhase = PodGroupPhase.INQUEUE,
+                    min_resources: Optional[Dict[str, str]] = None) -> PodGroup:
+    return PodGroup(
+        name=name, namespace=namespace,
+        spec=PodGroupSpec(min_member=min_member, queue=queue,
+                          min_resources=min_resources or {}),
+        status=PodGroupStatus(phase=phase),
+    )
+
+
+def build_queue(name: str, weight: int = 1,
+                capability: Optional[Dict[str, str]] = None,
+                reclaimable: Optional[bool] = None,
+                annotations: Optional[Dict[str, str]] = None) -> Queue:
+    return Queue(name=name,
+                 annotations=annotations or {},
+                 spec=QueueSpec(weight=weight, capability=capability or {},
+                                reclaimable=reclaimable))

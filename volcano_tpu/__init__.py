@@ -1,0 +1,27 @@
+"""volcano_tpu: a TPU-native batch scheduling framework.
+
+A from-scratch rebuild of the capabilities of yzs981130/volcano (a Kubernetes
+batch scheduler written in Go) designed TPU-first: the per-session
+allocate/preempt/backfill decision problem is solved as a batched task x node
+constraint-satisfaction kernel under jit/vmap on TPU (volcano_tpu.ops), while
+a thin Python control plane keeps the reference's semantics (sessions,
+statements, plugins, actions, controllers, admission, CLI).
+
+Layout:
+  api/          scheduler data model (Resource algebra, Task/Job/Node/Queue infos)
+  models/       CRD-shaped domain objects (batch Job, PodGroup, Queue, Command)
+  cache/        cluster-state cache + effector seams (Binder/Evictor/...)
+  framework/    Session, Statement, plugin/action registries
+  actions/      enqueue, allocate, backfill, preempt, reclaim, elect, reserve
+  plugins/      gang, drf, proportion, binpack, predicates, nodeorder, priority, ...
+  ops/          JAX/TPU kernels: snapshot flattening, feasibility, scoring, solvers
+  parallel/     device-mesh sharding of the solver (shard_map over the node axis)
+  controllers/  job/queue/podgroup/gc controllers + job plugins (svc, ssh, env)
+  webhooks/     admission validate/mutate
+  cli/          vcctl-equivalent CLI
+  conf/         scheduler configuration (YAML tiers, hot reload)
+  metrics/      prometheus-style metrics registry
+  utils/        priority queue, helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,239 @@
+"""TaskInfo and JobInfo: the scheduler's view of pods and podgroups.
+
+Reimplements reference pkg/scheduler/api/job_info.go:36-377 semantics on top
+of the TPU build's Pod/PodGroup model objects (volcano_tpu.models). The
+status-indexed task bookkeeping is kept because gang readiness
+(Ready/Pipelined) and the snapshot flattening both read it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .resource import Resource
+from .types import (
+    ALLOCATED_STATUSES,
+    POD_GROUP_ANNOTATION,
+    TaskStatus,
+    allocated_status,
+)
+from .unschedule_info import FitErrors
+
+
+def job_key_of_pod(pod) -> str:
+    """JobID for a pod: '<ns>/<group-name annotation>' (job_info.go getJobID)."""
+    group = (pod.annotations or {}).get(POD_GROUP_ANNOTATION, "")
+    if group:
+        return f"{pod.namespace}/{group}"
+    return ""
+
+
+def pod_key(pod) -> str:
+    return f"{pod.namespace}/{pod.name}"
+
+
+def get_pod_resource_without_init_containers(pod) -> Resource:
+    r = Resource()
+    for c in pod.containers:
+        r.add(Resource.from_resource_list(c.get("requests", {})))
+    return r
+
+
+def get_pod_resource_request(pod) -> Resource:
+    """Max(sum(containers), max(initContainers)) (k8s launch request)."""
+    r = get_pod_resource_without_init_containers(pod)
+    for c in pod.init_containers:
+        r.set_max_resource(Resource.from_resource_list(c.get("requests", {})))
+    return r
+
+
+def status_of_pod(pod) -> TaskStatus:
+    """Map pod phase -> TaskStatus (job_info.go getTaskStatus)."""
+    phase = pod.phase
+    if phase == "Running":
+        return TaskStatus.RELEASING if pod.deletion_timestamp else TaskStatus.RUNNING
+    if phase == "Pending":
+        if pod.deletion_timestamp:
+            return TaskStatus.RELEASING
+        return TaskStatus.BOUND if pod.node_name else TaskStatus.PENDING
+    if phase == "Unknown":
+        return TaskStatus.UNKNOWN
+    if phase == "Succeeded":
+        return TaskStatus.SUCCEEDED
+    if phase == "Failed":
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+class TaskInfo:
+    """Per-pod scheduling record (job_info.go:36-114)."""
+
+    __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
+                 "node_name", "status", "priority", "volume_ready", "pod")
+
+    def __init__(self, pod):
+        self.uid = pod.uid
+        self.job = job_key_of_pod(pod)
+        self.name = pod.name
+        self.namespace = pod.namespace
+        self.node_name = pod.node_name or ""
+        self.status = status_of_pod(pod)
+        self.priority = pod.priority if pod.priority is not None else 1
+        self.volume_ready = False
+        self.pod = pod
+        self.resreq = get_pod_resource_without_init_containers(pod)
+        self.init_resreq = get_pod_resource_request(pod)
+
+    def clone(self) -> "TaskInfo":
+        t = TaskInfo.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
+        t.volume_ready = self.volume_ready
+        t.pod = self.pod
+        t.resreq = self.resreq.clone()
+        t.init_resreq = self.init_resreq.clone()
+        return t
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def __repr__(self) -> str:
+        return (f"Task({self.namespace}/{self.name} job={self.job} "
+                f"status={self.status} node={self.node_name!r})")
+
+
+class JobInfo:
+    """Job = PodGroup + its tasks (job_info.go:125-377)."""
+
+    def __init__(self, uid: str, pod_group=None):
+        self.uid = uid
+        self.name = ""
+        self.namespace = ""
+        self.queue = ""
+        self.priority = 0
+        self.min_available = 0
+        self.pod_group = None
+        self.priority_class_name = ""
+        self.creation_timestamp = None
+
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.allocated = Resource()
+        self.total_request = Resource()
+        self.nodes_fit_errors: Dict[str, FitErrors] = {}
+        # Plugin-readiness bookkeeping (job controller plugins)
+        self.job = None  # batch Job CR when known
+
+        if pod_group is not None:
+            self.set_pod_group(pod_group)
+
+    # -- podgroup binding ---------------------------------------------------
+
+    def set_pod_group(self, pg) -> None:
+        self.name = pg.name
+        self.namespace = pg.namespace
+        self.queue = pg.spec.queue
+        self.priority_class_name = pg.spec.priority_class_name or ""
+        self.min_available = pg.spec.min_member
+        self.creation_timestamp = pg.creation_timestamp
+        self.pod_group = pg
+
+    # -- task bookkeeping ---------------------------------------------------
+
+    def _add_to_index(self, ti: TaskInfo) -> None:
+        self.task_status_index.setdefault(ti.status, {})[ti.key] = ti
+
+    def _remove_from_index(self, ti: TaskInfo) -> None:
+        bucket = self.task_status_index.get(ti.status)
+        if bucket is not None:
+            bucket.pop(ti.key, None)
+            if not bucket:
+                del self.task_status_index[ti.status]
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.key] = ti
+        self._add_to_index(ti)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+        self.total_request.add(ti.resreq)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.key)
+        if task is None:
+            raise KeyError(f"failed to find task <{ti.key}> in job <{self.uid}>")
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        self.total_request.sub(task.resreq)
+        del self.tasks[task.key]
+        self._remove_from_index(task)
+
+    def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
+        """Delete + reinsert keeping index/aggregates consistent
+        (job_info.go:207-224)."""
+        if ti.key in self.tasks:
+            self.delete_task_info(ti)
+        ti.status = status
+        self.add_task_info(ti)
+
+    # -- gang readiness -----------------------------------------------------
+
+    def ready_task_num(self) -> int:
+        """Allocated-status + succeeded + best-effort pending
+        (job_info.go:317-335)."""
+        occupied = 0
+        for status, tasks in self.task_status_index.items():
+            if allocated_status(status) or status == TaskStatus.SUCCEEDED:
+                occupied += len(tasks)
+            elif status == TaskStatus.PENDING:
+                occupied += sum(1 for t in tasks.values()
+                                if t.init_resreq.is_empty())
+        return occupied
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+
+    def valid_task_num(self) -> int:
+        occupied = 0
+        for status, tasks in self.task_status_index.items():
+            if (allocated_status(status)
+                    or status in (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED,
+                                  TaskStatus.PENDING)):
+                occupied += len(tasks)
+        return occupied
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    # -- misc ---------------------------------------------------------------
+
+    def clone(self) -> "JobInfo":
+        j = JobInfo(self.uid)
+        j.name, j.namespace, j.queue = self.name, self.namespace, self.queue
+        j.priority = self.priority
+        j.min_available = self.min_available
+        j.pod_group = self.pod_group
+        j.priority_class_name = self.priority_class_name
+        j.creation_timestamp = self.creation_timestamp
+        j.job = self.job
+        for ti in self.tasks.values():
+            j.add_task_info(ti.clone())
+        return j
+
+    def fit_message(self) -> str:
+        reasons = {str(s): len(t) for s, t in self.task_status_index.items()}
+        reasons["minAvailable"] = self.min_available
+        parts = sorted(f"{v} {k}" for k, v in reasons.items())
+        return f"pod group is not ready, {', '.join(parts)}."
+
+    def __repr__(self) -> str:
+        return (f"Job({self.namespace}/{self.name} queue={self.queue} "
+                f"minAvailable={self.min_available} tasks={len(self.tasks)})")

@@ -1,0 +1,162 @@
+"""NodeInfo: per-node resource accounting (reference api/node_info.go:27-392).
+
+The status-dependent Add/Remove accounting is preserved exactly — it is the
+ground truth the device arrays (idle / future-idle columns) are flattened
+from each session.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .job_info import TaskInfo
+from .resource import Resource
+from .types import NodePhase, TaskStatus
+
+
+class NodeState:
+    __slots__ = ("phase", "reason")
+
+    def __init__(self, phase: NodePhase = NodePhase.READY, reason: str = ""):
+        self.phase = phase
+        self.reason = reason
+
+
+class NodeInfo:
+    """Mutable per-node scheduling state."""
+
+    def __init__(self, node=None):
+        self.name = ""
+        self.node = None
+        self.state = NodeState(NodePhase.NOT_READY, "init")
+        self.releasing = Resource()   # being released by terminating tasks
+        self.pipelined = Resource()   # promised to pipelined tasks
+        self.idle = Resource()
+        self.used = Resource()
+        self.allocatable = Resource()
+        self.capability = Resource()
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.others: Dict[str, object] = {}
+        if node is not None:
+            self.set_node(node)
+
+    # -- node object sync ---------------------------------------------------
+
+    def _check_ready(self, node) -> bool:
+        for cond in node.conditions or []:
+            if cond.get("type") == "Ready" and cond.get("status") != "True":
+                self.state = NodeState(NodePhase.NOT_READY,
+                                       "node is not ready")
+                return False
+        if node.unschedulable:
+            self.state = NodeState(NodePhase.NOT_READY, "node is unschedulable")
+            return False
+        self.state = NodeState(NodePhase.READY)
+        return True
+
+    def set_node(self, node) -> None:
+        """Rebuild resource views from node.allocatable, replaying held tasks
+        (node_info.go:171-210)."""
+        if not self._check_ready(node):
+            # Keep self.node unset (reference keeps ni.Node nil) so held
+            # tasks skip resource accounting until the node turns ready.
+            self.name = node.name
+            return
+        self.name = node.name
+        self.node = node
+        self.allocatable = Resource.from_resource_list(node.allocatable)
+        self.capability = Resource.from_resource_list(node.capacity)
+        self.releasing = Resource()
+        self.pipelined = Resource()
+        self.idle = Resource.from_resource_list(node.allocatable)
+        self.used = Resource()
+        for ti in self.tasks.values():
+            if ti.status == TaskStatus.RELEASING:
+                self.idle.sub(ti.resreq)
+                self.releasing.add(ti.resreq)
+                self.used.add(ti.resreq)
+            elif ti.status == TaskStatus.PIPELINED:
+                self.pipelined.add(ti.resreq)
+            else:
+                self.idle.sub(ti.resreq)
+                self.used.add(ti.resreq)
+
+    @property
+    def ready(self) -> bool:
+        return self.state.phase == NodePhase.READY
+
+    def future_idle(self) -> Resource:
+        """idle + releasing - pipelined (node_info.go:57-59)."""
+        return self.idle.clone().add(self.releasing).sub(self.pipelined)
+
+    # -- task accounting ----------------------------------------------------
+
+    def _allocate_idle(self, ti: TaskInfo) -> None:
+        if not ti.resreq.less_equal(self.idle):
+            raise ValueError("selected node NotReady")
+        self.idle.sub(ti.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        """Status-dependent accounting (node_info.go:224-266). The node keeps
+        a clone so later task status flips don't corrupt node counters."""
+        if task.node_name and self.name and task.node_name != self.name:
+            raise ValueError(
+                f"task <{task.key}> already on different node <{task.node_name}>")
+        if task.key in self.tasks:
+            raise ValueError(f"task <{task.key}> already on node <{self.name}>")
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.RELEASING:
+                self._allocate_idle(ti)
+                self.releasing.add(ti.resreq)
+                self.used.add(ti.resreq)
+            elif ti.status == TaskStatus.PIPELINED:
+                self.pipelined.add(ti.resreq)
+            else:
+                self._allocate_idle(ti)
+                self.used.add(ti.resreq)
+        task.node_name = self.name
+        ti.node_name = self.name
+        self.tasks[ti.key] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.key)
+        if task is None:
+            raise KeyError(f"failed to find task <{ti.key}> on host <{self.name}>")
+        if self.node is not None:
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+                self.used.sub(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                self.pipelined.sub(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+                self.used.sub(task.resreq)
+        del self.tasks[task.key]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    def clone(self) -> "NodeInfo":
+        n = NodeInfo()
+        n.name = self.name
+        n.node = self.node
+        n.state = NodeState(self.state.phase, self.state.reason)
+        n.releasing = self.releasing.clone()
+        n.pipelined = self.pipelined.clone()
+        n.idle = self.idle.clone()
+        n.used = self.used.clone()
+        n.allocatable = self.allocatable.clone()
+        n.capability = self.capability.clone()
+        n.others = dict(self.others)
+        for k, t in self.tasks.items():
+            n.tasks[k] = t.clone()
+        return n
+
+    def pods(self):
+        return [t.pod for t in self.tasks.values()]
+
+    def __repr__(self) -> str:
+        return f"Node({self.name} idle={self.idle} used={self.used})"

@@ -1,0 +1,62 @@
+"""Unschedulability bookkeeping (reference api/unschedule_info.go:20-103)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+ALL_NODES_UNAVAILABLE = "all nodes are unavailable"
+
+# Canonical fit-failure reasons (mirrors k8s / reference message strings)
+NODE_RESOURCE_FIT_FAILED = "Insufficient resources"
+NODE_UNSCHEDULABLE = "node(s) were unschedulable"
+NODE_AFFINITY_FAILED = "node(s) didn't match node selector"
+TAINT_FAILED = "node(s) had taints that the pod didn't tolerate"
+POD_AFFINITY_FAILED = "node(s) didn't match pod affinity/anti-affinity"
+NODE_PORTS_FAILED = "node(s) didn't have free ports for the requested pod ports"
+POD_COUNT_FAILED = "node(s) had too many pods"
+
+
+class FitError:
+    """Why one task doesn't fit one node."""
+
+    __slots__ = ("task_namespace", "task_name", "node_name", "reasons")
+
+    def __init__(self, task, node_name: str, reasons: List[str]):
+        self.task_namespace = task.namespace
+        self.task_name = task.name
+        self.node_name = node_name
+        self.reasons = list(reasons)
+
+    def error(self) -> str:
+        return f"task {self.task_namespace}/{self.task_name} on node {self.node_name} fit failed: {', '.join(self.reasons)}"
+
+    __str__ = error
+
+
+class FitErrors:
+    """Per-task collection of per-node fit errors, histogrammed for the
+    PodGroup condition message."""
+
+    def __init__(self):
+        self.nodes: Dict[str, FitError] = {}
+        self.err: str = ""
+
+    def set_node_error(self, node_name: str, fe: FitError) -> None:
+        self.nodes[node_name] = fe
+
+    def set_error(self, err: str) -> None:
+        self.err = err
+
+    def error(self) -> str:
+        if self.err:
+            return self.err
+        if not self.nodes:
+            return ALL_NODES_UNAVAILABLE
+        hist: Dict[str, int] = {}
+        for fe in self.nodes.values():
+            for r in fe.reasons:
+                hist[r] = hist.get(r, 0) + 1
+        parts = sorted(f"{c} {r}" for r, c in hist.items())
+        return f"0/{len(self.nodes)} nodes are available: {', '.join(parts)}."
+
+    __str__ = error
