@@ -1,0 +1,239 @@
+"""Session/Statement/tier-dispatch tests."""
+
+import pytest
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.framework import (
+    Arguments, EventHandler, Plugin, ValidateResult, close_session,
+    open_session, register_plugin_builder,
+)
+from volcano_tpu.utils import PriorityQueue
+
+from helpers import build_node, build_pod, build_pod_group
+
+
+def make_session(tiers, pods=2, min_member=2):
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.run()
+    store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    store.create("podgroups", build_pod_group("pg1", "ns1", min_member=min_member))
+    for i in range(pods):
+        store.create("pods", build_pod("ns1", f"p{i}", "", "Pending",
+                                       {"cpu": "1", "memory": "1Gi"}, "pg1"))
+    return store, cache, open_session(cache, tiers)
+
+
+class _RecorderPlugin(Plugin):
+    """Registers order fns and records session-open/close calls."""
+
+    opened = 0
+    closed = 0
+
+    def __init__(self, args: Arguments):
+        self.args = args
+
+    def name(self):
+        return "recorder"
+
+    def on_session_open(self, ssn):
+        _RecorderPlugin.opened += 1
+        ssn.add_task_order_fn("recorder", lambda l, r:
+                              -1 if l.priority > r.priority else
+                              (1 if l.priority < r.priority else 0))
+
+    def on_session_close(self, ssn):
+        _RecorderPlugin.closed += 1
+
+
+register_plugin_builder("recorder", _RecorderPlugin)
+
+
+class TestSessionLifecycle:
+    def test_open_close_calls_plugins(self):
+        tiers = [Tier(plugins=[PluginOption(name="recorder")])]
+        before_open = _RecorderPlugin.opened
+        store, cache, ssn = make_session(tiers)
+        assert _RecorderPlugin.opened == before_open + 1
+        assert len(ssn.jobs) == 1 and len(ssn.nodes) == 1
+        close_session(ssn)
+        assert _RecorderPlugin.closed >= 1
+        assert not ssn.jobs and not ssn.plugins
+
+    def test_job_valid_filters_jobs(self):
+        class Rejector(Plugin):
+            def __init__(self, args):
+                pass
+
+            def name(self):
+                return "rejector"
+
+            def on_session_open(self, ssn):
+                ssn.add_job_valid_fn("rejector", lambda job: ValidateResult(
+                    False, "NotEnoughTasks", "job rejected"))
+
+            def on_session_close(self, ssn):
+                pass
+
+        register_plugin_builder("rejector", Rejector)
+        tiers = [Tier(plugins=[PluginOption(name="rejector")])]
+        store, cache, ssn = make_session(tiers)
+        assert not ssn.jobs  # all jobs filtered
+        job = cache.jobs["ns1/pg1"]
+        assert any(c.type == "Unschedulable"
+                   for c in job.pod_group.status.conditions)
+
+    def test_tier_order_first_answer_wins(self):
+        calls = []
+
+        class P(Plugin):
+            def __init__(self, name, answer):
+                self._name, self._answer = name, answer
+
+            def name(self):
+                return self._name
+
+            def on_session_open(self, ssn):
+                def fn(l, r, me=self._name, ans=self._answer):
+                    calls.append(me)
+                    return ans
+                ssn.add_job_order_fn(self._name, fn)
+
+            def on_session_close(self, ssn):
+                pass
+
+        register_plugin_builder("p-decisive", lambda a: P("p-decisive", -1))
+        register_plugin_builder("p-neutral", lambda a: P("p-neutral", 0))
+        tiers = [Tier(plugins=[PluginOption(name="p-neutral")]),
+                 Tier(plugins=[PluginOption(name="p-decisive")])]
+        store, cache, ssn = make_session(tiers)
+        job = next(iter(ssn.jobs.values()))
+        assert ssn.job_order_fn(job, job) is True  # decisive says l < r
+        assert calls == ["p-neutral", "p-decisive"]
+
+
+class TestVictimDispatch:
+    def _session_with(self, victim_plugins):
+        tiers = []
+        for i, (name, fn_builder) in enumerate(victim_plugins):
+            register_plugin_builder(name, fn_builder)
+            if i == 0 or True:
+                tiers.append(Tier(plugins=[PluginOption(name=name)]))
+        return make_session(tiers)
+
+    def test_intersection_within_tier(self):
+        class V(Plugin):
+            def __init__(self, name, picks):
+                self._name, self._picks = name, picks
+
+            def name(self):
+                return self._name
+
+            def on_session_open(self, ssn):
+                ssn.add_preemptable_fn(
+                    self._name,
+                    lambda preemptor, preemptees: [
+                        t for t in preemptees if t.name in self._picks])
+
+            def on_session_close(self, ssn):
+                pass
+
+        register_plugin_builder("v1", lambda a: V("v1", {"p0", "p1"}))
+        register_plugin_builder("v2", lambda a: V("v2", {"p1"}))
+        tiers = [Tier(plugins=[PluginOption(name="v1"),
+                               PluginOption(name="v2")])]
+        store, cache, ssn = make_session(tiers, pods=3, min_member=1)
+        tasks = list(ssn.jobs["ns1/pg1"].tasks.values())
+        victims = ssn.preemptable(tasks[0], tasks)
+        assert [v.name for v in victims] == ["p1"]
+
+    def test_empty_tier_falls_through(self):
+        class V(Plugin):
+            def __init__(self, name, picks):
+                self._name, self._picks = name, picks
+
+            def name(self):
+                return self._name
+
+            def on_session_open(self, ssn):
+                ssn.add_preemptable_fn(
+                    self._name,
+                    lambda preemptor, preemptees: [
+                        t for t in preemptees if t.name in self._picks])
+
+            def on_session_close(self, ssn):
+                pass
+
+        register_plugin_builder("vnone", lambda a: V("vnone", set()))
+        register_plugin_builder("vp2", lambda a: V("vp2", {"p2"}))
+        tiers = [Tier(plugins=[PluginOption(name="vnone")]),
+                 Tier(plugins=[PluginOption(name="vp2")])]
+        store, cache, ssn = make_session(tiers, pods=3, min_member=1)
+        tasks = list(ssn.jobs["ns1/pg1"].tasks.values())
+        victims = ssn.preemptable(tasks[0], tasks)
+        assert [v.name for v in victims] == ["p2"]
+
+
+class TestStatement:
+    def _open(self):
+        return make_session([], pods=2, min_member=2)
+
+    def test_allocate_commit_binds(self):
+        store, cache, ssn = self._open()
+        stmt = ssn.statement()
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(), key=lambda t: t.name)
+        for t in tasks:
+            stmt.allocate(t, "n1")
+        assert ssn.nodes["n1"].idle.milli_cpu == 8000 - 2000
+        stmt.commit()
+        assert set(cache.binder.binds) == {"ns1/p0", "ns1/p1"}
+        assert cache.binder.binds["ns1/p0"] == "n1"
+
+    def test_allocate_discard_restores(self):
+        store, cache, ssn = self._open()
+        stmt = ssn.statement()
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(), key=lambda t: t.name)
+        stmt.allocate(tasks[0], "n1")
+        stmt.discard()
+        assert not cache.binder.binds
+        assert ssn.nodes["n1"].idle.milli_cpu == 8000
+        assert tasks[0].status == TaskStatus.PENDING
+        assert tasks[0].node_name == ""
+
+    def test_pipeline_has_no_cache_effect(self):
+        store, cache, ssn = self._open()
+        stmt = ssn.statement()
+        t = sorted(ssn.jobs["ns1/pg1"].tasks.values(), key=lambda x: x.name)[0]
+        stmt.pipeline(t, "n1")
+        assert t.status == TaskStatus.PIPELINED
+        stmt.commit()
+        assert not cache.binder.binds
+
+    def test_event_handlers_fire(self):
+        store, cache, ssn = self._open()
+        events = []
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda e: events.append(("alloc", e.task.name)),
+            deallocate_func=lambda e: events.append(("dealloc", e.task.name))))
+        stmt = ssn.statement()
+        t = sorted(ssn.jobs["ns1/pg1"].tasks.values(), key=lambda x: x.name)[0]
+        stmt.allocate(t, "n1")
+        stmt.discard()
+        assert events == [("alloc", "p0"), ("dealloc", "p0")]
+
+
+class TestPriorityQueue:
+    def test_order_and_stability(self):
+        pq = PriorityQueue(lambda l, r: l[0] < r[0])
+        pq.push((2, "b"))
+        pq.push((1, "a"))
+        pq.push((2, "c"))
+        assert pq.pop() == (1, "a")
+        assert pq.pop() == (2, "b")  # FIFO among equals
+        assert pq.pop() == (2, "c")
+        assert pq.pop() is None

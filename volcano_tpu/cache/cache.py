@@ -1,0 +1,433 @@
+"""SchedulerCache: the cluster-state mirror behind every session.
+
+Reimplements reference pkg/scheduler/cache/{cache.go:71-855,
+event_handlers.go:43-710} against the TPU build's ClusterStore seam instead
+of client-go informers. Single-threaded (one host core): effector calls are
+synchronous, with the reference's resync-on-failure behavior preserved via an
+err-task queue drained at the top of each cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ..api import (
+    ClusterInfo, JobInfo, NamespaceCollection, NodeInfo, QueueInfo, Resource,
+    TaskInfo, TaskStatus,
+)
+from ..api.job_info import job_key_of_pod
+from ..models import (
+    PodGroup, PodGroupCondition, PodGroupPhase, Queue, QueueSpec,
+)
+from ..client.store import ClusterStore, NotFoundError
+
+log = logging.getLogger(__name__)
+
+
+class DefaultBinder:
+    """Writes the binding back to the cluster store (the reference POSTs a
+    v1.Binding; the store reflects it into pod.node_name like kubelet+etcd
+    would, cache.go:117-131)."""
+
+    def __init__(self, cluster: ClusterStore):
+        self.cluster = cluster
+
+    def bind(self, pod, hostname: str) -> None:
+        pod.node_name = hostname
+        pod.phase = "Running"
+        self.cluster.update("pods", pod)
+
+
+class DefaultEvictor:
+    """Sets PodReady=false then deletes the pod (cache.go:139-169)."""
+
+    def __init__(self, cluster: ClusterStore):
+        self.cluster = cluster
+
+    def evict(self, pod, reason: str) -> None:
+        pod.conditions = [c for c in pod.conditions if c.get("type") != "Ready"]
+        pod.conditions.append({"type": "Ready", "status": "False",
+                               "reason": "Evict", "message": reason})
+        self.cluster.update("pods", pod)
+        self.cluster.delete("pods", pod.name, pod.namespace)
+
+
+class DefaultStatusUpdater:
+    def __init__(self, cluster: ClusterStore):
+        self.cluster = cluster
+
+    def update_pod_condition(self, pod, condition: dict) -> None:
+        replaced = False
+        for i, c in enumerate(pod.conditions):
+            if c.get("type") == condition.get("type"):
+                pod.conditions[i] = condition
+                replaced = True
+        if not replaced:
+            pod.conditions.append(condition)
+        if self.cluster.try_get("pods", pod.name, pod.namespace) is not None:
+            self.cluster.update("pods", pod)
+
+    def update_pod_group(self, pg) -> None:
+        self.cluster.apply("podgroups", pg)
+
+
+class DefaultVolumeBinder:
+    """Volume Assume/Bind. The TPU build has no real PV controller; volumes
+    named in the pod spec are marked ready immediately (the seam exists so a
+    real CSI-backed implementation can plug in)."""
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        task.volume_ready = True
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        pass
+
+
+class SchedulerCache:
+    """Mirror of cluster state + effector plumbing."""
+
+    def __init__(self, cluster: Optional[ClusterStore] = None,
+                 scheduler_name: str = "volcano",
+                 default_queue: str = "default"):
+        self.cluster = cluster if cluster is not None else ClusterStore()
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, object] = {}
+        self.default_priority: int = 0
+        self.default_priority_class = None
+        self.namespace_collections: Dict[str, NamespaceCollection] = {}
+
+        self.binder = DefaultBinder(self.cluster)
+        self.evictor = DefaultEvictor(self.cluster)
+        self.status_updater = DefaultStatusUpdater(self.cluster)
+        self.volume_binder = DefaultVolumeBinder()
+
+        self._err_tasks: List[TaskInfo] = []
+        self._synced = False
+
+        self._create_default_queue()
+
+    # -- startup ------------------------------------------------------------
+
+    def _create_default_queue(self) -> None:
+        """Reference creates the default queue CR at startup
+        (cache.go:270-283)."""
+        if self.cluster.try_get("queues", self.default_queue) is None:
+            self.cluster.create(
+                "queues", Queue(name=self.default_queue, spec=QueueSpec(weight=1)))
+
+    def run(self) -> None:
+        """Subscribe to the store's watch streams (informer start)."""
+        c = self.cluster
+        c.watch("pods", self._on_pod)
+        c.watch("nodes", self._on_node)
+        c.watch("podgroups", self._on_podgroup)
+        c.watch("queues", self._on_queue)
+        c.watch("priorityclasses", self._on_priority_class)
+        c.watch("resourcequotas", self._on_resource_quota)
+        self._synced = True
+
+    def wait_for_cache_sync(self) -> bool:
+        return self._synced
+
+    # -- watch dispatch -----------------------------------------------------
+
+    def _on_pod(self, event, obj, old):
+        if event == "add":
+            self.add_pod(obj)
+        elif event == "update":
+            self.update_pod(old, obj)
+        else:
+            self.delete_pod(obj)
+
+    def _on_node(self, event, obj, old):
+        if event == "add":
+            self.add_node(obj)
+        elif event == "update":
+            self.update_node(obj)
+        else:
+            self.delete_node(obj)
+
+    def _on_podgroup(self, event, obj, old):
+        if event == "delete":
+            self.delete_pod_group(obj)
+        else:
+            self.set_pod_group(obj)
+
+    def _on_queue(self, event, obj, old):
+        if event == "delete":
+            self.delete_queue(obj)
+        else:
+            self.add_queue(obj)
+
+    def _on_priority_class(self, event, obj, old):
+        if event == "delete":
+            self.delete_priority_class(obj)
+        else:
+            self.add_priority_class(obj)
+
+    def _on_resource_quota(self, event, obj, old):
+        name = obj.namespace
+        coll = self.namespace_collections.setdefault(
+            name, NamespaceCollection(name))
+        if event == "delete":
+            coll.delete(obj)
+        else:
+            coll.update(obj)
+
+    # -- pod/task handlers (event_handlers.go:43-210) ------------------------
+
+    def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
+        if not ti.job:
+            return None  # bare pod: podgroup controller will wrap it
+        if ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def add_task(self, ti: TaskInfo) -> None:
+        job = self._get_or_create_job(ti)
+        if job is not None:
+            job.add_task_info(ti)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                self.nodes[ti.node_name] = NodeInfo()
+                self.nodes[ti.node_name].name = ti.node_name
+            self.nodes[ti.node_name].add_task(ti)
+
+    def add_pod(self, pod) -> None:
+        if pod.scheduler_name != self.scheduler_name:
+            return
+        self.add_task(TaskInfo(pod))
+
+    def delete_task(self, ti: TaskInfo) -> None:
+        job_err = node_err = None
+        if ti.job and ti.job in self.jobs:
+            try:
+                self.jobs[ti.job].delete_task_info(ti)
+            except KeyError as e:
+                job_err = e
+        if ti.node_name and ti.node_name in self.nodes:
+            try:
+                self.nodes[ti.node_name].remove_task(ti)
+            except KeyError as e:
+                node_err = e
+        if job_err or node_err:
+            raise KeyError(f"failed to delete task {ti.key}: {job_err} {node_err}")
+
+    def update_pod(self, old_pod, new_pod) -> None:
+        if new_pod.scheduler_name != self.scheduler_name:
+            return
+        try:
+            self.delete_task(TaskInfo(old_pod))
+        except KeyError:
+            pass
+        self.add_task(TaskInfo(new_pod))
+
+    def delete_pod(self, pod) -> None:
+        if pod.scheduler_name != self.scheduler_name:
+            return
+        ti = TaskInfo(pod)
+        try:
+            self.delete_task(ti)
+        except KeyError as e:
+            log.warning("delete_pod: %s", e)
+        job = self.jobs.get(ti.job)
+        if job is not None and not job.tasks and job.pod_group is None:
+            del self.jobs[ti.job]
+
+    # -- node handlers ------------------------------------------------------
+
+    def add_node(self, node) -> None:
+        if node.name in self.nodes:
+            self.nodes[node.name].set_node(node)
+        else:
+            ni = NodeInfo(node)
+            # preserve tasks recorded before the node object arrived
+            self.nodes[node.name] = ni
+
+    update_node = add_node
+
+    def delete_node(self, node) -> None:
+        self.nodes.pop(node.name, None)
+
+    # -- podgroup / queue / priorityclass handlers --------------------------
+
+    def set_pod_group(self, pg: PodGroup) -> None:
+        key = f"{pg.namespace}/{pg.name}"
+        if key not in self.jobs:
+            self.jobs[key] = JobInfo(key)
+        self.jobs[key].set_pod_group(pg)
+
+    def delete_pod_group(self, pg: PodGroup) -> None:
+        key = f"{pg.namespace}/{pg.name}"
+        job = self.jobs.get(key)
+        if job is None:
+            return
+        job.pod_group = None
+        if not job.tasks:
+            del self.jobs[key]
+
+    def add_queue(self, queue: Queue) -> None:
+        self.queues[queue.name] = QueueInfo(queue)
+
+    def delete_queue(self, queue: Queue) -> None:
+        self.queues.pop(queue.name, None)
+
+    def add_priority_class(self, pc) -> None:
+        if pc.global_default:
+            self.default_priority = pc.value
+            self.default_priority_class = pc
+        self.priority_classes[pc.name] = pc
+
+    def delete_priority_class(self, pc) -> None:
+        self.priority_classes.pop(pc.name, None)
+        if pc.global_default:
+            self.default_priority = 0
+            self.default_priority_class = None
+
+    # -- resync (cache.go:645-667) ------------------------------------------
+
+    def resync_task(self, task: TaskInfo) -> None:
+        self._err_tasks.append(task)
+
+    def process_resync_tasks(self) -> None:
+        """Re-sync err tasks from store truth (informer ground truth)."""
+        tasks, self._err_tasks = self._err_tasks, []
+        for task in tasks:
+            pod = self.cluster.try_get("pods", task.name, task.namespace)
+            try:
+                self.delete_task(task)
+            except KeyError:
+                pass
+            if pod is not None:
+                self.add_task(TaskInfo(pod))
+
+    # -- snapshot (cache.go:670-748) ----------------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        sn = ClusterInfo()
+        for name, ni in self.nodes.items():
+            if not ni.ready:
+                continue
+            sn.nodes[name] = ni.clone()
+        for name, qi in self.queues.items():
+            sn.queues[name] = qi.clone()
+        for name, coll in self.namespace_collections.items():
+            sn.namespace_info[name] = coll.snapshot()
+        for key, job in self.jobs.items():
+            if job.pod_group is None:
+                log.info("job %s skipped: scheduling spec undefined", key)
+                continue
+            if job.queue not in self.queues:
+                log.info("job %s skipped: queue %s not found", key, job.queue)
+                continue
+            clone = job.clone()
+            # resolve job priority from the PodGroup's priority class
+            clone.priority = self.default_priority
+            pc = self.priority_classes.get(clone.priority_class_name)
+            if pc is not None:
+                clone.priority = pc.value
+            sn.jobs[key] = clone
+        return sn
+
+    # -- effector paths (cache.go:450-578) ----------------------------------
+
+    def _find_job_and_task(self, ti: TaskInfo):
+        job = self.jobs.get(ti.job)
+        if job is None:
+            raise KeyError(f"failed to find Job {ti.job} for Task {ti.key}")
+        task = job.tasks.get(ti.key)
+        if task is None:
+            raise KeyError(f"failed to find task in status {ti.status} by key {ti.key}")
+        return job, task
+
+    def bind(self, ti: TaskInfo, hostname: str) -> None:
+        job, task = self._find_job_and_task(ti)
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to bind Task {ti.key} to host {hostname}: "
+                           "host does not exist")
+        original = task.status
+        job.update_task_status(task, TaskStatus.BINDING)
+        try:
+            node.add_task(task)
+        except ValueError:
+            job.update_task_status(task, original)
+            raise
+        try:
+            self.binder.bind(task.pod, hostname)
+        except Exception:
+            log.exception("bind failed for %s", task.key)
+            self.resync_task(task)
+
+    def evict(self, ti: TaskInfo, reason: str) -> None:
+        job, task = self._find_job_and_task(ti)
+        node = self.nodes.get(task.node_name)
+        if node is None:
+            raise KeyError(f"failed to evict Task {ti.key}: host "
+                           f"{task.node_name} does not exist")
+        original = task.status
+        job.update_task_status(task, TaskStatus.RELEASING)
+        try:
+            node.update_task(task)
+        except (ValueError, KeyError):
+            job.update_task_status(task, original)
+            raise
+        try:
+            self.evictor.evict(task.pod, reason)
+        except Exception:
+            log.exception("evict failed for %s", task.key)
+            self.resync_task(task)
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """Write the Unschedulable pod condition (cache.go:590-612)."""
+        self.status_updater.update_pod_condition(task.pod, {
+            "type": "PodScheduled", "status": "False",
+            "reason": "Unschedulable", "message": message,
+        })
+
+    # -- job status writes (cache.go:760-855) -------------------------------
+
+    def update_job_status(self, job: JobInfo, update_pg: bool = True) -> JobInfo:
+        if update_pg and job.pod_group is not None:
+            pg = job.pod_group
+            pg.status.running = len(
+                job.task_status_index.get(TaskStatus.RUNNING, {}))
+            pg.status.succeeded = len(
+                job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+            pg.status.failed = len(
+                job.task_status_index.get(TaskStatus.FAILED, {}))
+            self.status_updater.update_pod_group(pg)
+        self.record_job_status_event(job)
+        return job
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """Propagate per-task fit errors into pod conditions for
+        unschedulable jobs (cache.go:791-826)."""
+        if job.pod_group is None or job.ready():
+            return
+        base_msg = job.fit_message()
+        for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+            fit_errors = job.nodes_fit_errors.get(task.key)
+            msg = base_msg if fit_errors is None else fit_errors.error()
+            try:
+                self.task_unschedulable(task, msg)
+            except Exception:
+                log.exception("failed to update unschedulable condition for %s",
+                              task.key)
+
+    def string(self) -> str:
+        return (f"SchedulerCache(jobs={len(self.jobs)} nodes={len(self.nodes)} "
+                f"queues={len(self.queues)})")

@@ -1,0 +1,47 @@
+"""Fake effectors (reference pkg/scheduler/util/test_utils.go:94-160).
+
+Recorded binds/evictions make the whole solve loop hermetic: tests build a
+cache, run actions, then compare FakeBinder.binds against expectations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class FakeBinder:
+    def __init__(self):
+        self.binds: Dict[str, str] = {}   # "ns/pod" -> node
+        self.channel: List[str] = []
+
+    def bind(self, pod, hostname: str) -> None:
+        key = f"{pod.namespace}/{pod.name}"
+        self.binds[key] = hostname
+        self.channel.append(key)
+
+
+class FakeEvictor:
+    def __init__(self):
+        self.evicts: List[str] = []
+        self.channel: List[str] = []
+
+    def evict(self, pod, reason: str) -> None:
+        key = f"{pod.namespace}/{pod.name}"
+        self.evicts.append(key)
+        self.channel.append(key)
+
+
+class FakeStatusUpdater:
+    def update_pod_condition(self, pod, condition: dict) -> None:
+        pass
+
+    def update_pod_group(self, pg) -> None:
+        pass
+
+
+class FakeVolumeBinder:
+    def allocate_volumes(self, task, hostname: str) -> None:
+        pass
+
+    def bind_volumes(self, task) -> None:
+        pass

@@ -1,0 +1,5 @@
+"""In-memory cluster store: the API-server/informer seam."""
+
+from .store import (  # noqa: F401
+    AdmissionError, ClusterStore, ConflictError, NotFoundError,
+)

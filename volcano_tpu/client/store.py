@@ -1,0 +1,156 @@
+"""In-memory cluster store: the API-server/informer seam.
+
+The reference's "distributed communication backend" is the Kubernetes API
+server plus client-go informer watch streams (SURVEY.md §2.9 item 8). The TPU
+build replaces that with this process-local object store: typed buckets with
+create/update/delete plus synchronous watch listeners. The scheduler cache,
+controllers, webhooks and CLI all talk to a ClusterStore — in production the
+same interface is backed by the gRPC sidecar to a real control plane; in
+tests it is this in-memory implementation (the reference's fake-clientset
+pattern, pkg/client/clientset/versioned/fake).
+
+Admission plugs in as a create/update interceptor chain, mirroring the
+webhook-manager's mutate/validate path.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+Listener = Callable[[str, Any, Optional[Any]], None]  # (event, obj, old) event in {add, update, delete}
+Interceptor = Callable[[str, str, Any], Any]  # (verb, kind, obj) -> obj (may raise AdmissionError)
+
+KINDS = (
+    "pods", "nodes", "podgroups", "queues", "priorityclasses",
+    "resourcequotas", "jobs", "commands", "services", "configmaps",
+    "secrets", "pvcs",
+)
+
+
+class AdmissionError(Exception):
+    """Raised by an admission interceptor to deny a write."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class ConflictError(Exception):
+    """Stale-object write (resource_version mismatch)."""
+
+
+def _key(obj) -> str:
+    ns = getattr(obj, "namespace", None)
+    return f"{ns}/{obj.name}" if ns is not None else obj.name
+
+
+class ClusterStore:
+    """Typed object buckets + watch listeners. Single-threaded by design
+    (the host has one core; ordering is deterministic, which also makes the
+    informer-delta semantics testable)."""
+
+    def __init__(self):
+        self._buckets: Dict[str, Dict[str, Any]] = {k: {} for k in KINDS}
+        self._listeners: Dict[str, List[Listener]] = {k: [] for k in KINDS}
+        self._interceptors: List[Interceptor] = []
+        self._rv = 0
+
+    # -- admission ----------------------------------------------------------
+
+    def add_interceptor(self, fn: Interceptor) -> None:
+        self._interceptors.append(fn)
+
+    def _admit(self, verb: str, kind: str, obj):
+        for fn in self._interceptors:
+            obj = fn(verb, kind, obj)
+        return obj
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str, listener: Listener, replay: bool = True) -> None:
+        """Subscribe to a bucket; replay=True delivers existing objects as
+        adds first (informer list-then-watch semantics)."""
+        self._listeners[kind].append(listener)
+        if replay:
+            for obj in list(self._buckets[kind].values()):
+                listener("add", obj, None)
+
+    def _notify(self, kind: str, event: str, obj, old=None) -> None:
+        for fn in list(self._listeners[kind]):
+            fn(event, obj, old)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, kind: str, obj):
+        obj = self._admit("create", kind, obj)
+        key = _key(obj)
+        bucket = self._buckets[kind]
+        if key in bucket:
+            raise ConflictError(f"{kind} {key} already exists")
+        self._rv += 1
+        if hasattr(obj, "resource_version"):
+            obj.resource_version = self._rv
+        bucket[key] = obj
+        self._notify(kind, "add", obj)
+        return obj
+
+    def update(self, kind: str, obj):
+        obj = self._admit("update", kind, obj)
+        key = _key(obj)
+        bucket = self._buckets[kind]
+        old = bucket.get(key)
+        if old is None:
+            raise NotFoundError(f"{kind} {key} not found")
+        self._rv += 1
+        if hasattr(obj, "resource_version"):
+            obj.resource_version = self._rv
+        bucket[key] = obj
+        self._notify(kind, "update", obj, old)
+        return obj
+
+    def apply(self, kind: str, obj):
+        """Create-or-update."""
+        key = _key(obj)
+        if key in self._buckets[kind]:
+            return self.update(kind, obj)
+        return self.create(kind, obj)
+
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None):
+        key = f"{namespace}/{name}" if namespace is not None else name
+        bucket = self._buckets[kind]
+        obj = bucket.pop(key, None)
+        if obj is None:
+            raise NotFoundError(f"{kind} {key} not found")
+        self._admit("delete", kind, obj)
+        self._notify(kind, "delete", obj)
+        return obj
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None):
+        key = f"{namespace}/{name}" if namespace is not None else name
+        obj = self._buckets[kind].get(key)
+        if obj is None:
+            raise NotFoundError(f"{kind} {key} not found")
+        return obj
+
+    def try_get(self, kind: str, name: str, namespace: Optional[str] = None):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             name_glob: Optional[str] = None) -> List[Any]:
+        out = []
+        for obj in self._buckets[kind].values():
+            if namespace is not None and getattr(obj, "namespace", None) != namespace:
+                continue
+            if label_selector:
+                labels = getattr(obj, "labels", {}) or {}
+                if any(labels.get(k) != v for k, v in label_selector.items()):
+                    continue
+            if name_glob is not None and not fnmatch.fnmatch(obj.name, name_glob):
+                continue
+            out.append(obj)
+        return out

@@ -1,0 +1,24 @@
+"""Session event handlers (reference framework/event.go:23-32).
+
+Stateful plugins (drf/proportion/predicates) register Allocate/Deallocate
+callbacks so their shares stay incrementally consistent with every
+assign/unassign inside a session.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..api import TaskInfo
+
+
+@dataclass
+class Event:
+    task: TaskInfo
+
+
+@dataclass
+class EventHandler:
+    allocate_func: Optional[Callable[[Event], None]] = None
+    deallocate_func: Optional[Callable[[Event], None]] = None

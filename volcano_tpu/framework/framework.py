@@ -1,0 +1,71 @@
+"""open_session / close_session (reference framework/framework.go:30-64)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List
+
+from ..conf import Tier
+from .arguments import Arguments
+from .job_updater import JobUpdater
+from .registry import get_plugin_builder
+from .session import Session, job_status
+
+log = logging.getLogger(__name__)
+
+
+def open_session(cache, tiers: List[Tier], configurations=None) -> Session:
+    ssn = Session(cache, cache.snapshot())
+    ssn.tiers = tiers
+    ssn.configurations = configurations or []
+
+    for tier in tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                log.warning("failed to get plugin %s", opt.name)
+                continue
+            plugin = builder(Arguments(opt.arguments))
+            ssn.plugins[plugin.name()] = plugin
+            t0 = time.perf_counter()
+            plugin.on_session_open(ssn)
+            _metrics_plugin(plugin.name(), "OnSessionOpen", t0)
+
+    # JobValid pass (session.go:121-138): invalid jobs are removed from the
+    # session and their PodGroup gets an Unschedulable condition.
+    from ..models import PodGroupCondition, POD_GROUP_UNSCHEDULABLE_TYPE
+    for key, job in list(ssn.jobs.items()):
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.passed:
+            if job.pod_group is not None:
+                cond = PodGroupCondition(
+                    type=POD_GROUP_UNSCHEDULABLE_TYPE, status="True",
+                    transition_id=ssn.uid, reason=vr.reason, message=vr.message)
+                ssn.update_pod_group_condition(job, cond)
+            del ssn.jobs[key]
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    for name, plugin in ssn.plugins.items():
+        t0 = time.perf_counter()
+        plugin.on_session_close(ssn)
+        _metrics_plugin(name, "OnSessionClose", t0)
+
+    ju = JobUpdater(ssn)
+    ju.update_all()
+
+    ssn.jobs = {}
+    ssn.nodes = {}
+    ssn.plugins = {}
+    ssn.event_handlers = []
+    for reg in list(ssn.__dict__):
+        if reg.endswith("_fns"):
+            setattr(ssn, reg, {})
+
+
+def _metrics_plugin(plugin: str, phase: str, t0: float) -> None:
+    from ..metrics import metrics
+    metrics.plugin_scheduling_latency.observe(
+        time.perf_counter() - t0, labels={"plugin": plugin, "OnSession": phase})

@@ -1,0 +1,41 @@
+"""Action and Plugin interfaces (reference framework/interface.go:20-41)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Action:
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def initialize(self) -> None:
+        pass
+
+    def execute(self, ssn) -> None:
+        raise NotImplementedError
+
+    def un_initialize(self) -> None:
+        pass
+
+
+class Plugin:
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def on_session_open(self, ssn) -> None:
+        raise NotImplementedError
+
+    def on_session_close(self, ssn) -> None:
+        raise NotImplementedError
+
+
+class ValidateResult:
+    """Result of a JobValid fn (api/types.go ValidateResult)."""
+
+    __slots__ = ("passed", "reason", "message")
+
+    def __init__(self, passed: bool, reason: str = "", message: str = ""):
+        self.passed = passed
+        self.reason = reason
+        self.message = message

@@ -1,0 +1,52 @@
+"""JobUpdater: push PodGroup status back on session close.
+
+Reference framework/job_updater.go:16-108 fans out over 16 workers and
+jitters duplicate condition updates; the TPU build is single-core so the
+update loop is sequential, with the same skip-if-unchanged dedup.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .session import job_status
+
+log = logging.getLogger(__name__)
+
+
+def _conditions_equal(c1, c2) -> bool:
+    if len(c1) != len(c2):
+        return False
+    for a, b in zip(c1, c2):
+        # transition_id/time changes alone don't warrant an update
+        if (a.type, a.status, a.reason, a.message) != (b.type, b.status,
+                                                       b.reason, b.message):
+            return False
+    return True
+
+
+def _status_equal(s1, s2) -> bool:
+    return (s1.phase == s2.phase and s1.running == s2.running
+            and s1.succeeded == s2.succeeded and s1.failed == s2.failed)
+
+
+class JobUpdater:
+    def __init__(self, ssn):
+        self.ssn = ssn
+
+    def update_all(self) -> None:
+        for job in self.ssn.jobs.values():
+            self.update_job(job)
+
+    def update_job(self, job) -> None:
+        if job.pod_group is None:
+            return
+        import copy
+        old = copy.deepcopy(job.pod_group.status)
+        new = job_status(self.ssn, job)
+        update_pg = not (_status_equal(old, new)
+                         and _conditions_equal(old.conditions, new.conditions))
+        try:
+            self.ssn.cache.update_job_status(job, update_pg)
+        except Exception:
+            log.exception("failed to update job status for %s", job.uid)

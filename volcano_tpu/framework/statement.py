@@ -1,0 +1,150 @@
+"""Statement: transaction log of {Evict, Pipeline, Allocate} session ops.
+
+The gang all-or-nothing primitive (reference framework/statement.go:28-388):
+operations mutate session state immediately; Commit() applies side effects
+through the cache (bind/evict), Discard() undoes everything in reverse order.
+The TPU solver's assignments are replayed through exactly this boundary.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from dataclasses import dataclass
+from typing import List
+
+from ..api import TaskInfo, TaskStatus
+
+log = logging.getLogger(__name__)
+
+
+class Op(enum.Enum):
+    EVICT = "evict"
+    PIPELINE = "pipeline"
+    ALLOCATE = "allocate"
+
+
+@dataclass
+class _Operation:
+    name: Op
+    task: TaskInfo
+    reason: str = ""
+
+
+class Statement:
+    def __init__(self, ssn):
+        self.ssn = ssn
+        self.operations: List[_Operation] = []
+
+    # -- evict --------------------------------------------------------------
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """Mark Releasing in session now; the pod delete happens at Commit."""
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.RELEASING)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_deallocate(reclaimee)
+        self.operations.append(_Operation(Op.EVICT, reclaimee, reason))
+
+    def _commit_evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        try:
+            self.ssn.cache.evict(reclaimee, reason)
+        except Exception:
+            log.exception("commit evict failed for %s", reclaimee.key)
+            self._unevict(reclaimee)
+            raise
+
+    def _unevict(self, reclaimee: TaskInfo) -> None:
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.RUNNING)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_allocate(reclaimee)
+
+    # -- pipeline -----------------------------------------------------------
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PIPELINED)
+        task.node_name = hostname
+        node = self.ssn.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(_Operation(Op.PIPELINE, task))
+
+    def _unpipeline(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PENDING)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        task.node_name = ""
+        self.ssn._fire_deallocate(task)
+
+    # -- allocate -----------------------------------------------------------
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        self.ssn.cache.allocate_volumes(task, hostname)
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = hostname
+        node = self.ssn.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(_Operation(Op.ALLOCATE, task))
+
+    def _commit_allocate(self, task: TaskInfo) -> None:
+        try:
+            self.ssn.cache.bind_volumes(task)
+            self.ssn.cache.bind(task, task.node_name)
+        except Exception:
+            log.exception("commit allocate failed for %s", task.key)
+            self._unallocate(task)
+            raise
+
+    def _unallocate(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PENDING)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        task.node_name = ""
+        self.ssn._fire_deallocate(task)
+
+    # -- transaction boundary ----------------------------------------------
+
+    def commit(self) -> None:
+        """Apply side effects (statement.go:370-388)."""
+        for op in self.operations:
+            try:
+                if op.name == Op.EVICT:
+                    self._commit_evict(op.task, op.reason)
+                elif op.name == Op.ALLOCATE:
+                    self._commit_allocate(op.task)
+                # Pipeline has no cache side effect: the promise lives in
+                # session/PodGroup state until resources actually free.
+            except Exception:
+                continue
+        self.operations = []
+
+    def discard(self) -> None:
+        """Reverse-order undo (statement.go:345-367)."""
+        for op in reversed(self.operations):
+            if op.name == Op.EVICT:
+                self._unevict(op.task)
+            elif op.name == Op.PIPELINE:
+                self._unpipeline(op.task)
+            elif op.name == Op.ALLOCATE:
+                self._unallocate(op.task)
+        self.operations = []

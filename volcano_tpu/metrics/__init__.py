@@ -1,0 +1,4 @@
+"""Metrics (reference pkg/scheduler/metrics)."""
+
+from . import metrics  # noqa: F401
+from .metrics import Counter, Gauge, Histogram, Registry, registry  # noqa: F401

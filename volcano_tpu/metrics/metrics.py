@@ -1,0 +1,216 @@
+"""Prometheus-style metrics registry (reference pkg/scheduler/metrics/).
+
+A dependency-free implementation of counters/gauges/histograms with labels
+and text exposition, covering the reference's metric set
+(metrics.go:41-128, queue.go, job.go, namespace.go).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+VOLCANO_NAMESPACE = "volcano"
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = list(label_names)
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None):
+        k = _label_key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None):
+        self._values[_label_key(labels)] = value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def delete(self, labels: Optional[Dict[str, str]] = None):
+        self._values.pop(_label_key(labels), None)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+_DEF_BUCKETS = tuple(0.001 * (2 ** i) for i in range(15))  # 1ms .. ~16s
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_, label_names=(), buckets=_DEF_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sum: Dict[Tuple, float] = {}
+        self._n: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None):
+        k = _label_key(labels)
+        counts = self._counts.setdefault(k, [0] * len(self.buckets))
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                counts[i] += 1
+        self._sum[k] = self._sum.get(k, 0.0) + value
+        self._n[k] = self._n.get(k, 0) + 1
+
+    def get_count(self, labels=None) -> int:
+        return self._n.get(_label_key(labels), 0)
+
+    def get_sum(self, labels=None) -> float:
+        return self._sum.get(_label_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for k in sorted(self._n):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum = self._counts[k][i]
+                lk = k + (("le", repr(b)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            out.append(f"{self.name}_bucket{_fmt_labels(k + (('le', '+Inf'),))} {self._n[k]}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
+        return out
+
+
+def _fmt_labels(k: Tuple) -> str:
+    if not k:
+        return ""
+    inner = ",".join(f'{name}="{val}"' for name, val in k)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+
+    def register(self, m):
+        self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+registry = Registry()
+
+# -- scheduler metrics (metrics.go:41-128) ----------------------------------
+
+e2e_scheduling_latency = registry.register(Histogram(
+    "volcano_e2e_scheduling_latency_milliseconds",
+    "E2e scheduling latency in milliseconds"))
+action_scheduling_latency = registry.register(Histogram(
+    "volcano_action_scheduling_latency_microseconds",
+    "Action scheduling latency", ["action"]))
+plugin_scheduling_latency = registry.register(Histogram(
+    "volcano_plugin_scheduling_latency_microseconds",
+    "Plugin scheduling latency", ["plugin", "OnSession"]))
+task_scheduling_latency = registry.register(Histogram(
+    "volcano_task_scheduling_latency_milliseconds",
+    "Task scheduling latency"))
+schedule_attempts = registry.register(Counter(
+    "volcano_schedule_attempts_total",
+    "Number of attempts to schedule pods, by the result", ["result"]))
+pod_schedule_errors = registry.register(Counter(
+    "volcano_pod_schedule_errors", "Pods that failed to schedule"))
+pod_schedule_successes = registry.register(Counter(
+    "volcano_pod_schedule_successes", "Pods that scheduled"))
+preemption_victims = registry.register(Gauge(
+    "volcano_preemption_victims", "Number of selected preemption victims"))
+preemption_attempts = registry.register(Counter(
+    "volcano_total_preemption_attempts",
+    "Total preemption attempts in the cluster"))
+unschedule_task_count = registry.register(Gauge(
+    "volcano_unschedule_task_count", "Unschedulable task count", ["job_id"]))
+unschedule_job_count = registry.register(Gauge(
+    "volcano_unschedule_job_count", "Unschedulable job count"))
+
+# -- queue metrics (queue.go) ----------------------------------------------
+
+queue_allocated_milli_cpu = registry.register(Gauge(
+    "volcano_queue_allocated_milli_cpu", "Allocated CPU by queue", ["queue_name"]))
+queue_allocated_memory_bytes = registry.register(Gauge(
+    "volcano_queue_allocated_memory_bytes", "Allocated memory by queue", ["queue_name"]))
+queue_request_milli_cpu = registry.register(Gauge(
+    "volcano_queue_request_milli_cpu", "Requested CPU by queue", ["queue_name"]))
+queue_request_memory_bytes = registry.register(Gauge(
+    "volcano_queue_request_memory_bytes", "Requested memory by queue", ["queue_name"]))
+queue_deserved_milli_cpu = registry.register(Gauge(
+    "volcano_queue_deserved_milli_cpu", "Deserved CPU by queue", ["queue_name"]))
+queue_deserved_memory_bytes = registry.register(Gauge(
+    "volcano_queue_deserved_memory_bytes", "Deserved memory by queue", ["queue_name"]))
+queue_share = registry.register(Gauge(
+    "volcano_queue_share", "Share of queue", ["queue_name"]))
+queue_weight = registry.register(Gauge(
+    "volcano_queue_weight", "Weight of queue", ["queue_name"]))
+queue_overused = registry.register(Gauge(
+    "volcano_queue_overused", "Whether queue is overused", ["queue_name"]))
+queue_pod_group_inqueue_count = registry.register(Gauge(
+    "volcano_queue_pod_group_inqueue_count", "Inqueue PodGroup count", ["queue_name"]))
+queue_pod_group_pending_count = registry.register(Gauge(
+    "volcano_queue_pod_group_pending_count", "Pending PodGroup count", ["queue_name"]))
+queue_pod_group_running_count = registry.register(Gauge(
+    "volcano_queue_pod_group_running_count", "Running PodGroup count", ["queue_name"]))
+queue_pod_group_unknown_count = registry.register(Gauge(
+    "volcano_queue_pod_group_unknown_count", "Unknown PodGroup count", ["queue_name"]))
+
+# -- job / namespace metrics -----------------------------------------------
+
+job_share = registry.register(Gauge(
+    "volcano_job_share", "Share of job", ["job_ns", "job_id"]))
+job_retry_counts = registry.register(Counter(
+    "volcano_job_retry_counts", "Job retry counts", ["job_id"]))
+namespace_share = registry.register(Gauge(
+    "volcano_namespace_share", "Share of namespace", ["namespace_name"]))
+namespace_weight = registry.register(Gauge(
+    "volcano_namespace_weight", "Weight of namespace", ["namespace_name"]))
+
+
+def update_queue_metrics(queue_name: str, allocated, request, deserved=None,
+                         share: Optional[float] = None):
+    queue_allocated_milli_cpu.set(allocated.milli_cpu, {"queue_name": queue_name})
+    queue_allocated_memory_bytes.set(allocated.memory, {"queue_name": queue_name})
+    queue_request_milli_cpu.set(request.milli_cpu, {"queue_name": queue_name})
+    queue_request_memory_bytes.set(request.memory, {"queue_name": queue_name})
+    if deserved is not None:
+        queue_deserved_milli_cpu.set(deserved.milli_cpu, {"queue_name": queue_name})
+        queue_deserved_memory_bytes.set(deserved.memory, {"queue_name": queue_name})
+    if share is not None:
+        queue_share.set(share, {"queue_name": queue_name})

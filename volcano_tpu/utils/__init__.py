@@ -1,0 +1,3 @@
+"""Scheduler utilities (reference pkg/scheduler/util)."""
+
+from .priority_queue import PriorityQueue  # noqa: F401

@@ -1,0 +1,46 @@
+"""Heap on an injected less-fn (reference util/priority_queue.go:26-95)."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable
+
+
+class PriorityQueue:
+    """Stable heap ordered by a strict less(l, r) -> bool function."""
+
+    def __init__(self, less_fn: Callable[[Any, Any], bool]):
+        self._less = less_fn
+        self._heap = []
+        self._counter = itertools.count()
+
+    def push(self, item) -> None:
+        heapq.heappush(self._heap, _Entry(item, next(self._counter), self._less))
+
+    def pop(self):
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap).item
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class _Entry:
+    __slots__ = ("item", "seq", "less")
+
+    def __init__(self, item, seq, less):
+        self.item = item
+        self.seq = seq
+        self.less = less
+
+    def __lt__(self, other) -> bool:
+        if self.less(self.item, other.item):
+            return True
+        if self.less(other.item, self.item):
+            return False
+        return self.seq < other.seq
