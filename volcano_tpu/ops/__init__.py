@@ -1,0 +1,7 @@
+"""JAX/TPU kernels: snapshot flattening, feasibility, scoring, solvers."""
+
+from .arrays import ScoreParams, SnapshotArrays, bucket, flatten_snapshot  # noqa: F401
+from .solver import (  # noqa: F401
+    SolveResult, fits_matrix, score_matrix, solve_allocate,
+    solve_allocate_sequential,
+)

@@ -1,0 +1,364 @@
+"""Snapshot flattening: ClusterInfo -> padded device arrays.
+
+This is the TPU equivalent of the reference's parallel snapshot clone
+(cache.go:693-742): each session the host flattens the cluster into
+fixed-shape float32/int32 arrays (padded to compile buckets so XLA reuses
+compiled executables across cycles) and ships them to the device in one
+transfer. Mapping tables (tasks_list / nodes_list / jobs_list) translate
+solver outputs back into TaskInfo/NodeInfo objects for Statement replay.
+
+Predicate masks are precomputed host-side per unique constraint signature
+(node selector + affinity + tolerations hash) so the device matrix is a
+cheap gather: sig_masks[S, N] with S = number of distinct signatures, which
+is tiny in practice even when T is 10k.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import (
+    JobInfo, NodeInfo, Resource, ResourceVocab, TaskInfo, TaskStatus,
+    MIN_MILLI_SCALAR,
+)
+
+#: compile-bucket sizes: quarter-steps between powers of two, floor 8 —
+#: keeps the number of distinct compiled shapes logarithmic in cluster size
+#: while capping padding overhead at 25%
+def bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        for frac in (1.25, 1.5, 1.75, 2.0):
+            cand = int(b * frac)
+            if cand >= n:
+                return cand
+        b *= 2
+    return b
+
+
+def _match_node_selector(selector: Dict[str, str], node) -> bool:
+    labels = node.labels or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _tolerates(tolerations: List[dict], node) -> bool:
+    """NoSchedule/NoExecute taints must be tolerated (predicates plugin)."""
+    for taint in node.taints or []:
+        if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+            continue
+        tolerated = False
+        for tol in tolerations or []:
+            op = tol.get("operator", "Equal")
+            if tol.get("key") and tol["key"] != taint.get("key"):
+                continue
+            if op == "Equal" and tol.get("value") != taint.get("value"):
+                continue
+            if tol.get("effect") and tol["effect"] != taint.get("effect"):
+                continue
+            tolerated = True
+            break
+        if not tolerated:
+            return False
+    return True
+
+
+def _node_affinity_match(affinity: Optional[dict], node) -> bool:
+    """requiredDuringSchedulingIgnoredDuringExecution node affinity subset:
+    matchExpressions with In/NotIn/Exists/DoesNotExist operators."""
+    if not affinity:
+        return True
+    na = affinity.get("nodeAffinity") or {}
+    req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not req:
+        return True
+    labels = node.labels or {}
+    for term in req.get("nodeSelectorTerms", []):
+        ok = True
+        for expr in term.get("matchExpressions", []):
+            key, op = expr.get("key"), expr.get("operator")
+            vals = expr.get("values", [])
+            has = key in labels
+            if op == "In":
+                ok &= has and labels[key] in vals
+            elif op == "NotIn":
+                ok &= not (has and labels[key] in vals)
+            elif op == "Exists":
+                ok &= has
+            elif op == "DoesNotExist":
+                ok &= not has
+            if not ok:
+                break
+        if ok:
+            return True  # terms are ORed
+    return False
+
+
+def _signature(task: TaskInfo) -> str:
+    pod = task.pod
+    return json.dumps({
+        "sel": sorted((pod.node_selector or {}).items()),
+        "aff": pod.affinity,
+        "tol": pod.tolerations,
+        "ports": sorted(pod.ports()),
+    }, sort_keys=True, default=str)
+
+
+@dataclass
+class ScoreParams:
+    """Scalar weights feeding the on-device scoring families. Plugins set
+    these during OnSessionOpen (binpack/nodeorder register here instead of
+    per-(task,node) Python callbacks)."""
+
+    binpack_weight: float = 0.0
+    binpack_res_weights: Optional[np.ndarray] = None  # [R]
+    least_req_weight: float = 0.0
+    most_req_weight: float = 0.0
+    balanced_weight: float = 0.0
+    # static per-node score added for every task (e.g. node-affinity
+    # preferences evaluated host-side): [N]
+    node_static: Optional[np.ndarray] = None
+
+    def resolved(self, R: int, N: int) -> "ScoreParams":
+        p = ScoreParams(
+            binpack_weight=self.binpack_weight,
+            least_req_weight=self.least_req_weight,
+            most_req_weight=self.most_req_weight,
+            balanced_weight=self.balanced_weight)
+        p.binpack_res_weights = (
+            np.ones(R, dtype=np.float32) if self.binpack_res_weights is None
+            else np.asarray(self.binpack_res_weights, dtype=np.float32))
+        p.node_static = (
+            np.zeros(N, dtype=np.float32) if self.node_static is None
+            else np.asarray(self.node_static, dtype=np.float32))
+        return p
+
+
+@dataclass
+class SnapshotArrays:
+    """Padded array view of one session's decision problem."""
+
+    vocab: ResourceVocab
+    # -- tasks (pending tasks of schedulable jobs, in scheduling order) -----
+    tasks_list: List[TaskInfo] = field(default_factory=list)
+    task_init_req: np.ndarray = None    # [T,R] launch request (fit check)
+    task_req: np.ndarray = None         # [T,R] running request (accounting)
+    task_job: np.ndarray = None         # [T] -> job index
+    task_rank: np.ndarray = None        # [T] global priority order (0 first)
+    task_sig: np.ndarray = None         # [T] -> signature index
+    task_counts_ready: np.ndarray = None  # [T] bool: counts toward gang
+    task_valid: np.ndarray = None       # [T] bool
+    # -- jobs ----------------------------------------------------------------
+    jobs_list: List[JobInfo] = field(default_factory=list)
+    job_min: np.ndarray = None          # [J]
+    job_ready_base: np.ndarray = None   # [J] ready_task_num at snapshot
+    job_queue: np.ndarray = None        # [J] -> queue index
+    job_valid: np.ndarray = None        # [J] bool
+    # -- nodes ---------------------------------------------------------------
+    nodes_list: List[NodeInfo] = field(default_factory=list)
+    node_idle: np.ndarray = None        # [N,R]
+    node_extra_future: np.ndarray = None  # [N,R] releasing - pipelined
+    node_used: np.ndarray = None        # [N,R]
+    node_alloc: np.ndarray = None       # [N,R] allocatable
+    node_npods: np.ndarray = None       # [N]
+    node_max_pods: np.ndarray = None    # [N]
+    node_valid: np.ndarray = None       # [N] bool
+    # -- predicate signatures ------------------------------------------------
+    sig_masks: np.ndarray = None        # [S,N] bool
+    # -- queues --------------------------------------------------------------
+    queues_list: List[str] = field(default_factory=list)
+    queue_weight: np.ndarray = None     # [Q]
+    queue_capability: np.ndarray = None  # [Q,R] (inf where uncapped)
+    queue_allocated: np.ndarray = None  # [Q,R]
+    # -- misc ----------------------------------------------------------------
+    thresholds: np.ndarray = None       # [R]
+    scalar_dim_mask: np.ndarray = None  # [R] bool: dims 2+ (ignorable)
+
+    @property
+    def T(self) -> int:
+        return self.task_init_req.shape[0]
+
+    @property
+    def N(self) -> int:
+        return self.node_idle.shape[0]
+
+    @property
+    def R(self) -> int:
+        return self.task_init_req.shape[1]
+
+    @property
+    def J(self) -> int:
+        return self.job_min.shape[0]
+
+    def device_dict(self) -> Dict[str, np.ndarray]:
+        """The arrays the solver kernel consumes (one host->device hop)."""
+        return {
+            "task_init_req": self.task_init_req,
+            "task_req": self.task_req,
+            "task_job": self.task_job,
+            "task_rank": self.task_rank,
+            "task_sig": self.task_sig,
+            "task_counts_ready": self.task_counts_ready,
+            "task_valid": self.task_valid,
+            "job_min": self.job_min,
+            "job_ready_base": self.job_ready_base,
+            "job_queue": self.job_queue,
+            "job_valid": self.job_valid,
+            "node_idle": self.node_idle,
+            "node_extra_future": self.node_extra_future,
+            "node_used": self.node_used,
+            "node_alloc": self.node_alloc,
+            "node_npods": self.node_npods,
+            "node_max_pods": self.node_max_pods,
+            "node_valid": self.node_valid,
+            "sig_masks": self.sig_masks,
+            "thresholds": self.thresholds,
+            "scalar_dim_mask": self.scalar_dim_mask,
+        }
+
+
+def flatten_snapshot(
+    jobs: Dict[str, JobInfo],
+    nodes: Dict[str, NodeInfo],
+    tasks_in_order: List[TaskInfo],
+    vocab: Optional[ResourceVocab] = None,
+    queues: Optional[Dict[str, object]] = None,
+) -> SnapshotArrays:
+    """Flatten session state into padded arrays.
+
+    tasks_in_order: the pending tasks to place, already sorted by the
+    session's namespace/queue/job/task ordering (host-side comparator pass —
+    the ordering semantics stay in Python, the math goes on device).
+    Tasks must be grouped by job within the order.
+    """
+    if vocab is None:
+        resources = []
+        for ni in nodes.values():
+            resources.append(ni.allocatable)
+        for t in tasks_in_order:
+            resources.append(t.init_resreq)
+        vocab = ResourceVocab.collect(resources)
+
+    R = len(vocab)
+    nodes_list = [n for n in nodes.values() if n.ready]
+    N = bucket(max(len(nodes_list), 1))
+    T = bucket(max(len(tasks_in_order), 1))
+
+    job_keys: List[str] = []
+    job_index: Dict[str, int] = {}
+    for t in tasks_in_order:
+        if t.job not in job_index:
+            job_index[t.job] = len(job_keys)
+            job_keys.append(t.job)
+    # +1 guarantees a padded (invalid) job slot: padded tasks point there so
+    # the sequential solver's job-boundary logic never revisits a real job
+    J = bucket(len(job_keys) + 1)
+
+    arr = SnapshotArrays(vocab=vocab)
+    arr.tasks_list = list(tasks_in_order)
+    arr.nodes_list = nodes_list
+    arr.jobs_list = [jobs[k] for k in job_keys]
+
+    arr.task_init_req = np.zeros((T, R), dtype=np.float32)
+    arr.task_req = np.zeros((T, R), dtype=np.float32)
+    arr.task_job = np.full(T, J - 1, dtype=np.int32)  # padded job slot
+    arr.task_rank = np.arange(T, dtype=np.int32)
+    arr.task_sig = np.zeros(T, dtype=np.int32)
+    arr.task_counts_ready = np.zeros(T, dtype=bool)
+    arr.task_valid = np.zeros(T, dtype=bool)
+
+    sigs: Dict[str, int] = {}
+    sig_tasks: List[TaskInfo] = []
+    for i, t in enumerate(tasks_in_order):
+        arr.task_init_req[i] = t.init_resreq.to_vector(vocab)
+        arr.task_req[i] = t.resreq.to_vector(vocab)
+        arr.task_job[i] = job_index[t.job]
+        s = _signature(t)
+        if s not in sigs:
+            sigs[s] = len(sigs)
+            sig_tasks.append(t)
+        arr.task_sig[i] = sigs[s]
+        # best-effort pending tasks already count in ready_task_num
+        arr.task_counts_ready[i] = not t.init_resreq.is_empty()
+        arr.task_valid[i] = True
+
+    arr.job_min = np.zeros(J, dtype=np.int32)
+    arr.job_ready_base = np.zeros(J, dtype=np.int32)
+    arr.job_queue = np.zeros(J, dtype=np.int32)
+    arr.job_valid = np.zeros(J, dtype=bool)
+    queue_index: Dict[str, int] = {}
+    queue_names: List[str] = []
+    for j, key in enumerate(job_keys):
+        job = jobs[key]
+        arr.job_min[j] = job.min_available
+        arr.job_ready_base[j] = job.ready_task_num()
+        arr.job_valid[j] = True
+        if job.queue not in queue_index:
+            queue_index[job.queue] = len(queue_names)
+            queue_names.append(job.queue)
+        arr.job_queue[j] = queue_index[job.queue]
+
+    arr.node_idle = np.zeros((N, R), dtype=np.float32)
+    arr.node_extra_future = np.zeros((N, R), dtype=np.float32)
+    arr.node_used = np.zeros((N, R), dtype=np.float32)
+    arr.node_alloc = np.ones((N, R), dtype=np.float32)  # avoid div by 0 in pads
+    arr.node_npods = np.zeros(N, dtype=np.int32)
+    arr.node_max_pods = np.zeros(N, dtype=np.int32)
+    arr.node_valid = np.zeros(N, dtype=bool)
+    for i, ni in enumerate(nodes_list):
+        arr.node_idle[i] = ni.idle.to_vector(vocab)
+        fut = ni.releasing.to_vector(vocab) - ni.pipelined.to_vector(vocab)
+        arr.node_extra_future[i] = fut
+        arr.node_used[i] = ni.used.to_vector(vocab)
+        alloc = ni.allocatable.to_vector(vocab)
+        arr.node_alloc[i] = np.where(alloc > 0, alloc, 1.0)
+        arr.node_npods[i] = len([
+            t for t in ni.tasks.values() if t.status != TaskStatus.PIPELINED])
+        arr.node_max_pods[i] = ni.allocatable.max_task_num or 1 << 30
+        arr.node_valid[i] = True
+
+    S = max(len(sigs), 1)
+    arr.sig_masks = np.zeros((S, N), dtype=bool)
+    if not sig_tasks:
+        arr.sig_masks[:, :] = True
+    for s_idx, t in enumerate(sig_tasks):
+        pod = t.pod
+        for n_idx, ni in enumerate(nodes_list):
+            node = ni.node
+            ok = True
+            if node is not None:
+                ok = (_match_node_selector(pod.node_selector or {}, node)
+                      and _tolerates(pod.tolerations, node)
+                      and _node_affinity_match(pod.affinity, node))
+                if ok and pod.ports():
+                    taken = set()
+                    for other in ni.tasks.values():
+                        taken.update(other.pod.ports())
+                    ok = not (set(pod.ports()) & taken)
+            arr.sig_masks[s_idx, n_idx] = ok
+
+    # queues (water-filling inputs; filled further by proportion plugin)
+    Q = bucket(max(len(queue_names), 1))
+    arr.queues_list = queue_names
+    arr.queue_weight = np.ones(Q, dtype=np.float32)
+    arr.queue_capability = np.full((Q, R), np.inf, dtype=np.float32)
+    arr.queue_allocated = np.zeros((Q, R), dtype=np.float32)
+    if queues:
+        for name, q_idx in queue_index.items():
+            qi = queues.get(name)
+            if qi is None:
+                continue
+            arr.queue_weight[q_idx] = getattr(qi, "weight", 1) or 1
+            cap = getattr(qi, "capability", None)
+            if cap:
+                cap_vec = Resource.from_resource_list(cap).to_vector(vocab)
+                arr.queue_capability[q_idx] = np.where(
+                    cap_vec > 0, cap_vec, np.inf)
+
+    arr.thresholds = vocab.thresholds()
+    arr.scalar_dim_mask = np.zeros(R, dtype=bool)
+    arr.scalar_dim_mask[2:] = True
+    return arr
