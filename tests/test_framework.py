@@ -65,7 +65,9 @@ class TestSessionLifecycle:
         assert _RecorderPlugin.closed >= 1
         assert not ssn.jobs and not ssn.plugins
 
-    def test_job_valid_filters_jobs(self):
+    def test_job_valid_vetoes_via_dispatch(self):
+        # openSession does NOT filter (the reference's filter runs before
+        # plugins register, so it never fires); actions consult job_valid
         class Rejector(Plugin):
             def __init__(self, args):
                 pass
@@ -83,10 +85,9 @@ class TestSessionLifecycle:
         register_plugin_builder("rejector", Rejector)
         tiers = [Tier(plugins=[PluginOption(name="rejector")])]
         store, cache, ssn = make_session(tiers)
-        assert not ssn.jobs  # all jobs filtered
-        job = cache.jobs["ns1/pg1"]
-        assert any(c.type == "Unschedulable"
-                   for c in job.pod_group.status.conditions)
+        assert ssn.jobs  # jobs stay in the session
+        vr = ssn.job_valid(ssn.jobs["ns1/pg1"])
+        assert vr is not None and not vr.passed
 
     def test_tier_order_first_answer_wins(self):
         calls = []

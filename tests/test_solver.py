@@ -116,10 +116,11 @@ class TestSolveAllocate:
         node_idx = int(np.asarray(res.assigned)[0])
         assert arr.nodes_list[node_idx].name == "n2"
 
-    def test_pipeline_only_job_is_discarded(self, solver):
-        # node full but releasing; a gang-unready job that could only
-        # pipeline gets discarded (reference: JobReady counts allocated,
-        # not pipelined -> stmt.Discard)
+    def test_pipeline_only_job_stays_pipelined_but_unready(self, solver):
+        # node full but releasing: the task pipelines onto FutureIdle; the
+        # job is not gang-ready (pipelined doesn't count), but the pipeline
+        # reservation survives — ssn.Pipeline is outside the Statement in
+        # the reference, so Discard doesn't undo it
         ni = NodeInfo(build_node("n1", {"cpu": "2", "memory": "8Gi"}))
         running = TaskInfo(build_pod("ns", "old", "n1", "Running",
                                      {"cpu": "2", "memory": "1Gi"}, "oldpg"))
@@ -133,7 +134,8 @@ class TestSolveAllocate:
         job.add_task_info(t)
         arr = flatten_snapshot({"ns/j1": job}, {"n1": ni}, [t])
         res = solver(arr, params_dict(arr, least_req_weight=1.0))
-        assert int(np.asarray(res.assigned)[0]) == -1
+        assert int(np.asarray(res.assigned)[0]) == 0
+        assert int(np.asarray(res.kind)[0]) == 1
         assert not np.asarray(res.job_ready)[0]
 
     def test_pipeline_survives_when_job_ready_via_running(self, solver):

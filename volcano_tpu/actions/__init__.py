@@ -1,0 +1,22 @@
+"""Actions (reference pkg/scheduler/actions)."""
+
+from ..framework import register_action
+from .allocate import AllocateAction  # noqa: F401
+from .backfill import BackfillAction  # noqa: F401
+from .enqueue import EnqueueAction  # noqa: F401
+
+
+def register_all() -> None:
+    register_action(EnqueueAction())
+    register_action(AllocateAction())
+    register_action(BackfillAction())
+    for name in ("preempt", "reclaim", "elect", "reserve"):
+        try:
+            import importlib
+            mod = importlib.import_module(f".{name}", __package__)
+            register_action(getattr(mod, f"{name.capitalize()}Action")())
+        except (ImportError, AttributeError):
+            pass
+
+
+register_all()

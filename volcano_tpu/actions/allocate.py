@@ -1,0 +1,257 @@
+"""Allocate action: the hot path (reference actions/allocate/allocate.go:43-266).
+
+Two execution modes:
+
+- solver (default): collect pending tasks in the session's
+  namespace/queue/job/task order (host-side comparators), flatten the
+  decision problem into padded device arrays, run ops.solve_allocate on TPU,
+  and replay the returned assignments through Statement/Pipeline — the
+  ordering and transaction semantics stay in the control plane, the
+  task x node math runs on device.
+- host: a faithful per-task loop (predicate -> prioritize -> best node ->
+  allocate/pipeline) used when custom host-only plugins are present, for
+  parity testing, and as the reference semantics oracle.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..api import Resource, TaskStatus
+from ..api.unschedule_info import (
+    ALL_NODES_UNAVAILABLE, FitError, FitErrors, NODE_RESOURCE_FIT_FAILED,
+)
+from ..framework import Action, Arguments
+from ..models import PodGroupPhase
+from ..utils import PriorityQueue
+
+log = logging.getLogger(__name__)
+
+
+class AllocateAction(Action):
+    def name(self) -> str:
+        return "allocate"
+
+    # ------------------------------------------------------------------
+    # shared: job/task ordering
+    # ------------------------------------------------------------------
+
+    def _ordered_jobs(self, ssn):
+        """Yield schedulable jobs in namespace -> queue -> job order,
+        skipping Pending-phase podgroups, invalid jobs, unknown queues and
+        overused queues (allocate.go:61-160)."""
+        namespaces = PriorityQueue(ssn.namespace_order_fn)
+        jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            if job.queue not in ssn.queues:
+                continue
+            ns = job.namespace
+            if ns not in jobs_map:
+                jobs_map[ns] = {}
+                namespaces.push(ns)
+            jobs_map[ns].setdefault(
+                job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+
+        while not namespaces.empty():
+            ns = namespaces.pop()
+            queue_map = jobs_map[ns]
+            queue = None
+            for qname in list(queue_map):
+                qi = ssn.queues[qname]
+                if ssn.overused(qi):
+                    del queue_map[qname]
+                    continue
+                if queue is None or ssn.queue_order_fn(qi, queue):
+                    queue = qi
+            if queue is None:
+                continue
+            jobs = queue_map.get(queue.name)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+            yield job
+            namespaces.push(ns)
+
+    def _pending_tasks(self, ssn, job) -> List:
+        """Pending, non-best-effort tasks in task order
+        (allocate.go:175-189)."""
+        pq = PriorityQueue(ssn.task_order_fn)
+        for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+            if task.resreq.is_empty():
+                continue  # BestEffort tasks are backfill's
+            pq.push(task)
+        out = []
+        while not pq.empty():
+            out.append(pq.pop())
+        return out
+
+    # ------------------------------------------------------------------
+    # solver mode
+    # ------------------------------------------------------------------
+
+    def _execute_solver(self, ssn, sequential: bool = False) -> None:
+        from ..ops import flatten_snapshot, solve_allocate, \
+            solve_allocate_sequential
+
+        job_order = []
+        tasks_in_order = []
+        for job in self._ordered_jobs(ssn):
+            tasks = self._pending_tasks(ssn, job)
+            if tasks:
+                job_order.append((job, tasks))
+                tasks_in_order.extend(tasks)
+        if not tasks_in_order:
+            return
+
+        arr = flatten_snapshot(
+            {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
+            queues=ssn.queues)
+
+        sp = ssn.score_params
+        weights_fn = ssn.solver_options.get("binpack_vocab_weights")
+        if weights_fn is not None:
+            sp.binpack_res_weights = weights_fn(arr.vocab)
+        rp = sp.resolved(arr.R, arr.N)
+        params = {
+            "binpack_weight": np.float32(rp.binpack_weight),
+            "binpack_res_weights": rp.binpack_res_weights,
+            "least_req_weight": np.float32(rp.least_req_weight),
+            "most_req_weight": np.float32(rp.most_req_weight),
+            "balanced_weight": np.float32(rp.balanced_weight),
+            "node_static": rp.node_static,
+        }
+        families = []
+        if rp.binpack_weight:
+            families.append("binpack")
+        if rp.least_req_weight or rp.most_req_weight or rp.balanced_weight:
+            families.append("kube")
+        if not families:
+            families = ["kube"]
+        herd = ssn.solver_options.get("herd_mode")
+        if herd is None:
+            herd = "pack" if rp.binpack_weight > (
+                rp.least_req_weight + rp.balanced_weight) else "spread"
+
+        if sequential:
+            res = solve_allocate_sequential(
+                arr.device_dict(), params, score_families=tuple(families))
+        else:
+            res = solve_allocate(
+                arr.device_dict(), params, herd_mode=herd,
+                score_families=tuple(families))
+        assigned = np.asarray(res.assigned)
+        kind = np.asarray(res.kind)
+
+        # replay through the Statement boundary in job order
+        idx = 0
+        for job, tasks in job_order:
+            stmt = ssn.statement()
+            for task in tasks:
+                t_idx = idx
+                idx += 1
+                node_idx = int(assigned[t_idx])
+                if node_idx < 0:
+                    fe = FitErrors()
+                    fe.set_error(ALL_NODES_UNAVAILABLE)
+                    job.nodes_fit_errors[task.key] = fe
+                    continue
+                node_name = arr.nodes_list[node_idx].name
+                try:
+                    if kind[t_idx] == 0:
+                        stmt.allocate(task, node_name)
+                    else:
+                        ssn.pipeline(task, node_name)
+                except (KeyError, ValueError):
+                    log.exception("replay failed for %s", task.key)
+            if ssn.job_ready(job):
+                stmt.commit()
+            else:
+                stmt.discard()
+
+    # ------------------------------------------------------------------
+    # host mode (reference per-task loop)
+    # ------------------------------------------------------------------
+
+    def _predicate(self, ssn, task, node) -> None:
+        if not task.init_resreq.less_equal(node.future_idle()):
+            from ..plugins.predicates import PredicateError
+            raise PredicateError(
+                FitError(task, node.name, [NODE_RESOURCE_FIT_FAILED]))
+        ssn.predicate_fn(task, node)
+
+    def _execute_host(self, ssn) -> None:
+        from ..plugins.predicates import PredicateError
+
+        for job in self._ordered_jobs(ssn):
+            tasks = self._pending_tasks(ssn, job)
+            # The reference requeues a ready job with remaining tasks and
+            # continues it in a fresh statement; the inner loop below is the
+            # single-job equivalent (job interleaving differs, final
+            # placements match).
+            while tasks:
+                stmt = ssn.statement()
+                progressed = False
+                stuck = False
+                while tasks:
+                    task = tasks.pop(0)
+                    fit_errors = FitErrors()
+                    candidates = []
+                    for node in ssn.nodes.values():
+                        try:
+                            self._predicate(ssn, task, node)
+                            candidates.append(node)
+                        except PredicateError as e:
+                            fit_errors.set_node_error(node.name, e.fit_error)
+                    if not candidates:
+                        job.nodes_fit_errors[task.key] = fit_errors
+                        stuck = True
+                        break
+                    candidates = [
+                        n for n in candidates
+                        if task.init_resreq.less_equal(n.idle)
+                        or task.init_resreq.less_equal(n.future_idle())]
+                    if not candidates:
+                        continue
+                    scores = {n.name: ssn.node_order_fn(task, n)
+                              for n in candidates}
+                    batch = ssn.batch_node_order_fn(task, candidates)
+                    for name, s in batch.items():
+                        scores[name] = scores.get(name, 0.0) + s
+                    best = ssn.best_node_fn(task, scores)
+                    if best is None:
+                        best = max(candidates, key=lambda n: scores[n.name])
+                    if task.init_resreq.less_equal(best.idle):
+                        stmt.allocate(task, best.name)
+                    else:
+                        ssn.pipeline(task, best.name)
+                    progressed = True
+                    if ssn.job_ready(job) and tasks:
+                        break
+                if ssn.job_ready(job):
+                    stmt.commit()
+                    if stuck or not progressed:
+                        break
+                else:
+                    stmt.discard()
+                    break
+
+    def execute(self, ssn) -> None:
+        mode = "solver"
+        for conf in ssn.configurations:
+            if conf.name == self.name():
+                mode = Arguments(conf.arguments).get("mode", "solver")
+        if mode == "host":
+            self._execute_host(ssn)
+        elif mode == "sequential":
+            self._execute_solver(ssn, sequential=True)
+        else:
+            self._execute_solver(ssn)

@@ -1,0 +1,77 @@
+"""Enqueue action (reference actions/enqueue/enqueue.go:56-174).
+
+Pending PodGroups go Inqueue when the cluster's overcommitted idle can hold
+their MinResources and every JobEnqueueable fn passes.
+"""
+
+from __future__ import annotations
+
+from ..api import Resource
+from ..framework import Action, Arguments
+from ..models import PodGroupPhase
+from ..utils import PriorityQueue
+
+DEFAULT_OVERCOMMIT_FACTOR = 1.2
+
+
+class EnqueueAction(Action):
+    def name(self) -> str:
+        return "enqueue"
+
+    def _overcommit_factor(self, ssn) -> float:
+        for conf in ssn.configurations:
+            if conf.name == self.name():
+                return Arguments(conf.arguments).get_float(
+                    "overcommit-factor", DEFAULT_OVERCOMMIT_FACTOR)
+        return DEFAULT_OVERCOMMIT_FACTOR
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        queue_set = set()
+        jobs_map = {}
+
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            if queue.uid not in queue_set:
+                queue_set.add(queue.uid)
+                queues.push(queue)
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                jobs_map.setdefault(
+                    job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+
+        total, used = Resource(), Resource()
+        for node in ssn.nodes.values():
+            total.add(node.allocatable)
+            used.add(node.used)
+        idle = total.clone().multi(self._overcommit_factor(ssn))
+        try:
+            idle.sub(used)
+        except ValueError:
+            idle = Resource()
+
+        while not queues.empty():
+            if idle.is_empty():
+                break
+            queue = queues.pop()
+            jobs = jobs_map.get(queue.name)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+
+            inqueue = False
+            if not job.pod_group.spec.min_resources:
+                inqueue = True
+            else:
+                min_req = Resource.from_resource_list(
+                    job.pod_group.spec.min_resources)
+                if ssn.job_enqueueable(job) and min_req.less_equal(idle):
+                    try:
+                        idle.sub(min_req)
+                    except ValueError:
+                        idle = Resource()
+                    inqueue = True
+            if inqueue:
+                job.pod_group.status.phase = PodGroupPhase.INQUEUE
+            queues.push(queue)

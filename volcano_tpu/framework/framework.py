@@ -32,18 +32,11 @@ def open_session(cache, tiers: List[Tier], configurations=None) -> Session:
             plugin.on_session_open(ssn)
             _metrics_plugin(plugin.name(), "OnSessionOpen", t0)
 
-    # JobValid pass (session.go:121-138): invalid jobs are removed from the
-    # session and their PodGroup gets an Unschedulable condition.
-    from ..models import PodGroupCondition, POD_GROUP_UNSCHEDULABLE_TYPE
-    for key, job in list(ssn.jobs.items()):
-        vr = ssn.job_valid(job)
-        if vr is not None and not vr.passed:
-            if job.pod_group is not None:
-                cond = PodGroupCondition(
-                    type=POD_GROUP_UNSCHEDULABLE_TYPE, status="True",
-                    transition_id=ssn.uid, reason=vr.reason, message=vr.message)
-                ssn.update_pod_group_condition(job, cond)
-            del ssn.jobs[key]
+    # NOTE: the reference's openSession contains a JobValid filter
+    # (session.go:121-138), but it runs BEFORE plugins register their
+    # jobValidFns, so it never fires; the real filtering happens inside each
+    # action (allocate/backfill check ssn.JobValid). We mirror that: no
+    # filtering here — enqueue must still see pod-less Pending podgroups.
     return ssn
 
 

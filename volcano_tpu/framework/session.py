@@ -75,8 +75,12 @@ class Session:
             setattr(self, reg, {})
         self.event_handlers: List[EventHandler] = []
 
-        # device-array view, built on demand by ops.session_arrays(ssn)
-        self.arrays = None
+        # TPU seam: plugins contribute scalar weights for the on-device
+        # scoring families here instead of per-(task,node) callbacks; the
+        # allocate action feeds them to ops.solve_allocate
+        from ..ops.arrays import ScoreParams
+        self.score_params = ScoreParams()
+        self.solver_options: Dict[str, object] = {}
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)

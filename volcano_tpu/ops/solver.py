@@ -297,25 +297,25 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             a["task_job"], num_segments=J)
         ready = (a["job_ready_base"] + alloc_counts) >= a["job_min"]
         ready = ready & a["job_valid"]
-        # revert unready jobs that DID get assignments (Statement.Discard);
-        # unready jobs with nothing assigned stay eligible — resources a
-        # revert frees may let them place in the next gang iteration
-        has_assign = jax.ops.segment_sum(
-            (assigned >= 0).astype(jnp.int32), a["task_job"],
+        # revert unready jobs that DID get allocations (Statement.Discard);
+        # pipelined tasks are NOT statement ops in the reference
+        # (allocate.go pipelines via ssn.Pipeline) so they survive discard
+        # and keep holding FutureIdle. Unready jobs with nothing allocated
+        # stay eligible — resources a revert frees may let them place in the
+        # next gang iteration.
+        has_alloc = jax.ops.segment_sum(
+            ((assigned >= 0) & (kind == 0)).astype(jnp.int32), a["task_job"],
             num_segments=J) > 0
-        revert_job = ~ready & a["job_valid"] & ~excluded & has_assign
-        revert_task = revert_job[a["task_job"]] & (assigned >= 0)
+        revert_job = ~ready & a["job_valid"] & ~excluded & has_alloc
+        revert_task = (revert_job[a["task_job"]] & (assigned >= 0)
+                       & (kind == 0))
         credit = jax.ops.segment_sum(
-            a["task_req"] * (revert_task & (kind == 0))[:, None],
-            jnp.maximum(assigned, 0), num_segments=N)
-        pipe_credit = jax.ops.segment_sum(
-            a["task_req"] * (revert_task & (kind == 1))[:, None],
+            a["task_req"] * revert_task[:, None],
             jnp.maximum(assigned, 0), num_segments=N)
         pod_credit = jax.ops.segment_sum(
-            (revert_task & (kind == 0)).astype(jnp.int32),
+            revert_task.astype(jnp.int32),
             jnp.maximum(assigned, 0), num_segments=N)
         idle = idle + credit
-        pipe = pipe - pipe_credit
         npods = npods - pod_credit
         assigned = jnp.where(revert_task, -1, assigned)
         kind = jnp.where(revert_task, -1, kind)
@@ -370,14 +370,15 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
         return jnp.all(dim_ok | ignored, axis=-1)
 
     def finalize_job(carry, jidx):
-        """Gang-check job jidx; revert if unready."""
+        """Gang-check job jidx; revert its allocations if unready (pipelined
+        tasks survive discard, mirroring ssn.Pipeline being outside the
+        Statement in allocate.go)."""
         (idle, pipe, npods, assigned, kind, jalloc,
          snap_idle, snap_pipe, snap_npods) = carry
         ready = (a["job_ready_base"][jidx] + jalloc) >= a["job_min"][jidx]
         is_job = (a["task_job"] == jidx)
-        revert = is_job & (assigned >= 0) & ~ready
+        revert = is_job & (assigned >= 0) & (kind == 0) & ~ready
         idle = jnp.where(ready, idle, snap_idle)
-        pipe = jnp.where(ready, pipe, snap_pipe)
         npods = jnp.where(ready, npods, snap_npods)
         assigned = jnp.where(revert, -1, assigned)
         kind = jnp.where(revert, -1, kind)

@@ -1,0 +1,91 @@
+"""Predicates plugin (reference plugins/predicates/predicates.go:100-255).
+
+Wraps the k8s filter set the reference uses: NodeUnschedulable, NodeAffinity
+(+ nodeSelector), TaintToleration, NodePorts, pod-count, and (optionally)
+InterPodAffinity. Two forms:
+
+- host predicate fn registered on the session (exact per-pair semantics for
+  backfill/preempt/reclaim paths and tests);
+- for the allocate solver, the same constraints are flattened into
+  sig_masks by ops.flatten_snapshot (signature gather), so the plugin's job
+  there is only to declare that the mask set is active.
+"""
+
+from __future__ import annotations
+
+from ..api import FitError
+from ..api.unschedule_info import (
+    NODE_AFFINITY_FAILED, NODE_PORTS_FAILED, NODE_UNSCHEDULABLE,
+    POD_AFFINITY_FAILED, POD_COUNT_FAILED, TAINT_FAILED,
+)
+from ..framework import Plugin
+from ..ops.arrays import (
+    _match_node_selector, _node_affinity_match, _tolerates,
+)
+
+
+class PredicateError(Exception):
+    def __init__(self, fit_error: FitError):
+        super().__init__(fit_error.error())
+        self.fit_error = fit_error
+
+
+def _pod_affinity_ok(pod, node, tasks_on_node) -> bool:
+    """Minimal inter-pod affinity/anti-affinity: requiredDuringScheduling
+    terms with matchLabels over topologyKey kubernetes.io/hostname."""
+    aff = pod.affinity or {}
+    for kind, want in (("podAffinity", True), ("podAntiAffinity", False)):
+        spec = aff.get(kind) or {}
+        for term in spec.get("requiredDuringSchedulingIgnoredDuringExecution", []):
+            sel = (term.get("labelSelector") or {}).get("matchLabels", {})
+            matched = any(
+                all((t.pod.labels or {}).get(k) == v for k, v in sel.items())
+                for t in tasks_on_node)
+            if want and not matched:
+                return False
+            if not want and matched:
+                return False
+    return True
+
+
+class PredicatesPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+
+    def name(self) -> str:
+        return "predicates"
+
+    def on_session_open(self, ssn) -> None:
+        ssn.solver_options["predicates"] = True
+
+        def predicate_fn(task, node_info):
+            node = node_info.node
+            pod = task.pod
+            reasons = []
+            if node is None or not node_info.ready:
+                reasons.append(NODE_UNSCHEDULABLE)
+            else:
+                max_tasks = node_info.allocatable.max_task_num
+                if max_tasks and len(node_info.tasks) >= max_tasks:
+                    reasons.append(POD_COUNT_FAILED)
+                if not _match_node_selector(pod.node_selector or {}, node) \
+                        or not _node_affinity_match(pod.affinity, node):
+                    reasons.append(NODE_AFFINITY_FAILED)
+                if not _tolerates(pod.tolerations, node):
+                    reasons.append(TAINT_FAILED)
+                if pod.ports():
+                    taken = set()
+                    for other in node_info.tasks.values():
+                        taken.update(other.pod.ports())
+                    if set(pod.ports()) & taken:
+                        reasons.append(NODE_PORTS_FAILED)
+                if pod.affinity and not _pod_affinity_ok(
+                        pod, node, list(node_info.tasks.values())):
+                    reasons.append(POD_AFFINITY_FAILED)
+            if reasons:
+                raise PredicateError(FitError(task, node_info.name, reasons))
+
+        ssn.add_predicate_fn(self.name(), predicate_fn)
+
+    def on_session_close(self, ssn) -> None:
+        pass

@@ -1,0 +1,45 @@
+"""Priority plugin (reference plugins/priority/priority.go:43-107)."""
+
+from __future__ import annotations
+
+from ..framework import Plugin
+
+
+class PriorityPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+
+    def name(self) -> str:
+        return "priority"
+
+    def on_session_open(self, ssn) -> None:
+        def task_order_fn(l, r):
+            if l.priority == r.priority:
+                return 0
+            return -1 if l.priority > r.priority else 1
+
+        ssn.add_task_order_fn(self.name(), task_order_fn)
+
+        def job_order_fn(l, r):
+            if l.priority == r.priority:
+                return 0
+            return -1 if l.priority > r.priority else 1
+
+        ssn.add_job_order_fn(self.name(), job_order_fn)
+
+        def preemptable_fn(preemptor, preemptees):
+            """Victims must belong to strictly lower-priority jobs."""
+            p_job = ssn.jobs.get(preemptor.job)
+            if p_job is None:
+                return []
+            victims = []
+            for preemptee in preemptees:
+                job = ssn.jobs.get(preemptee.job)
+                if job is not None and job.priority < p_job.priority:
+                    victims.append(preemptee)
+            return victims
+
+        ssn.add_preemptable_fn(self.name(), preemptable_fn)
+
+    def on_session_close(self, ssn) -> None:
+        pass

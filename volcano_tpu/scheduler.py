@@ -1,0 +1,99 @@
+"""Scheduler: the periodic session loop (reference pkg/scheduler/scheduler.go:39-110).
+
+Each cycle: load (possibly hot-reloaded) conf -> OpenSession -> run each
+configured action -> CloseSession. The conf file is watched by mtime (the
+reference uses fsnotify; polling keeps this dependency-free).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from . import actions as _actions  # noqa: F401  (registers actions)
+from . import plugins as _plugins  # noqa: F401  (registers plugins)
+from .cache import SchedulerCache
+from .conf import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+from .framework import close_session, get_action, open_session
+from .metrics import metrics
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SCHEDULE_PERIOD = 1.0  # seconds (options.go:83)
+
+
+class Scheduler:
+    def __init__(self, cache: SchedulerCache,
+                 scheduler_conf: Optional[str] = None,
+                 conf_path: Optional[str] = None,
+                 period: float = DEFAULT_SCHEDULE_PERIOD):
+        self.cache = cache
+        self.period = period
+        self.conf_path = conf_path
+        self._conf_mtime = 0.0
+        self._conf_text = scheduler_conf or DEFAULT_SCHEDULER_CONF
+        self.actions = []
+        self.tiers = []
+        self.configurations = []
+        self.load_conf()
+
+    # -- conf hot reload (scheduler.go:112-170) -----------------------------
+
+    def load_conf(self) -> None:
+        text = self._conf_text
+        if self.conf_path and os.path.exists(self.conf_path):
+            mtime = os.path.getmtime(self.conf_path)
+            if mtime != self._conf_mtime:
+                self._conf_mtime = mtime
+                with open(self.conf_path) as f:
+                    text = f.read()
+                self._conf_text = text
+        conf = load_scheduler_conf(text)
+        acts = []
+        for name in conf.actions:
+            action = get_action(name)
+            if action is None:
+                raise ValueError(f"failed to find action {name}")
+            acts.append(action)
+        self.actions = acts
+        self.tiers = conf.tiers
+        self.configurations = conf.configurations
+
+    # -- the loop -----------------------------------------------------------
+
+    def run_once(self) -> None:
+        t0 = time.perf_counter()
+        self.load_conf()
+        ssn = open_session(self.cache, self.tiers, self.configurations)
+        try:
+            for action in self.actions:
+                ta = time.perf_counter()
+                action.execute(ssn)
+                metrics.action_scheduling_latency.observe(
+                    (time.perf_counter() - ta) * 1e6,
+                    labels={"action": action.name()})
+        finally:
+            close_session(ssn)
+        metrics.e2e_scheduling_latency.observe(
+            (time.perf_counter() - t0) * 1e3)
+
+    def run(self, stop_after: Optional[int] = None) -> None:
+        """Run the periodic loop; stop_after bounds cycles for tests."""
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+        cycles = 0
+        while stop_after is None or cycles < stop_after:
+            start = time.time()
+            self.cache.process_resync_tasks()
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("scheduling cycle failed")
+            cycles += 1
+            if stop_after is not None and cycles >= stop_after:
+                break
+            elapsed = time.time() - start
+            if elapsed < self.period:
+                time.sleep(self.period - elapsed)
