@@ -1,0 +1,218 @@
+"""DRF / hierarchical DRF / proportion tests (reference hdrf_test.go,
+proportion semantics)."""
+
+import pytest
+
+from volcano_tpu.api import Resource, TaskStatus
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.conf import Configuration, PluginOption, Tier
+from volcano_tpu.framework import close_session, get_action, open_session
+from volcano_tpu.models import PodGroupPhase
+
+from helpers import build_node, build_pod, build_pod_group, build_queue
+
+
+def make_cluster(nodes, podgroups, pods, queues=()):
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.run()
+    for q in queues:
+        store.apply("queues", q)
+    for n in nodes:
+        store.create("nodes", n)
+    for pg in podgroups:
+        store.create("podgroups", pg)
+    for p in pods:
+        store.create("pods", p)
+    return store, cache
+
+
+class TestProportion:
+    def _session(self, queues, podgroups, pods, nodes):
+        store, cache = make_cluster(nodes, podgroups, pods, queues)
+        tiers = [Tier(plugins=[PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="proportion"),
+                               PluginOption(name="nodeorder")])]
+        return cache, open_session(cache, tiers)
+
+    def test_water_filling_by_weight(self):
+        # 2 queues, weights 3:1, both requesting more than deserved ->
+        # deserved splits the 12-cpu cluster 9:3
+        queues = [build_queue("q1", weight=3), build_queue("q2", weight=1)]
+        pgs = [build_pod_group("pg1", queue="q1"),
+               build_pod_group("pg2", queue="q2")]
+        pods = ([build_pod("default", f"a{i}", "", "Pending",
+                           {"cpu": "1", "memory": "1Gi"}, "pg1")
+                 for i in range(12)]
+                + [build_pod("default", f"b{i}", "", "Pending",
+                             {"cpu": "1", "memory": "1Gi"}, "pg2")
+                   for i in range(12)])
+        nodes = [build_node("n1", {"cpu": "12", "memory": "100Gi"})]
+        cache, ssn = self._session(queues, pgs, pods, nodes)
+        pp = ssn.plugins["proportion"]
+        assert pp.queue_opts["q1"].deserved.milli_cpu == pytest.approx(9000)
+        assert pp.queue_opts["q2"].deserved.milli_cpu == pytest.approx(3000)
+        close_session(ssn)
+
+    def test_deserved_clamped_by_request(self):
+        queues = [build_queue("q1", weight=1), build_queue("q2", weight=1)]
+        pgs = [build_pod_group("pg1", queue="q1"),
+               build_pod_group("pg2", queue="q2")]
+        # q1 requests only 2 cpu; q2 requests a lot -> q2 gets the rest
+        pods = ([build_pod("default", f"a{i}", "", "Pending",
+                           {"cpu": "1", "memory": "1Gi"}, "pg1")
+                 for i in range(2)]
+                + [build_pod("default", f"b{i}", "", "Pending",
+                             {"cpu": "1", "memory": "1Gi"}, "pg2")
+                   for i in range(20)])
+        nodes = [build_node("n1", {"cpu": "12", "memory": "100Gi"})]
+        cache, ssn = self._session(queues, pgs, pods, nodes)
+        pp = ssn.plugins["proportion"]
+        assert pp.queue_opts["q1"].deserved.milli_cpu == pytest.approx(2000)
+        assert pp.queue_opts["q2"].deserved.milli_cpu == pytest.approx(10000)
+        close_session(ssn)
+
+    def test_overused_and_allocation_stops(self):
+        # q1 runs 20 of 24 cpus; 1:1 water-filling gives it deserved=18 ->
+        # overused, so allocate skips q1's pending pod entirely
+        queues = [build_queue("q1", weight=1), build_queue("q2", weight=1)]
+        pgs = [build_pod_group("pg1", queue="q1"),
+               build_pod_group("pg2", queue="q2")]
+        pods = ([build_pod("default", f"a{i}", "n1", "Running",
+                           {"cpu": "2", "memory": "1Gi"}, "pg1")
+                 for i in range(6)]
+                + [build_pod("default", f"a{i}", "n2", "Running",
+                             {"cpu": "2", "memory": "1Gi"}, "pg1")
+                   for i in range(6, 10)]
+                + [build_pod("default", "a-new", "", "Pending",
+                             {"cpu": "1", "memory": "1Gi"}, "pg1")]
+                + [build_pod("default", f"b{i}", "", "Pending",
+                             {"cpu": "1", "memory": "1Gi"}, "pg2")
+                   for i in range(12)])
+        nodes = [build_node("n1", {"cpu": "12", "memory": "100Gi"}),
+                 build_node("n2", {"cpu": "12", "memory": "100Gi"})]
+        cache, ssn = self._session(queues, pgs, pods, nodes)
+        pp = ssn.plugins["proportion"]
+        assert pp.queue_opts["q1"].deserved.milli_cpu == pytest.approx(12000)
+        assert ssn.overused(ssn.queues["q1"])
+        assert not ssn.overused(ssn.queues["q2"])
+        # allocate skips the overused queue: only q2 pods get bound
+        get_action("allocate").execute(ssn)
+        bound = set(cache.binder.binds)
+        assert all(k.startswith("default/b") for k in bound)
+        assert len(bound) == 4
+        close_session(ssn)
+
+    def test_enqueueable_respects_capability(self):
+        queues = [build_queue("q1", weight=1,
+                              capability={"cpu": "4", "memory": "100Gi"})]
+        pg1 = build_pod_group("pg1", queue="q1", phase=PodGroupPhase.PENDING,
+                              min_resources={"cpu": "3", "memory": "1Gi"})
+        pg2 = build_pod_group("pg2", queue="q1", phase=PodGroupPhase.PENDING,
+                              min_resources={"cpu": "3", "memory": "1Gi"})
+        nodes = [build_node("n1", {"cpu": "100", "memory": "1000Gi"})]
+        cache, ssn = self._session(queues, [pg1, pg2], [], nodes)
+        get_action("enqueue").execute(ssn)
+        phases = sorted(j.pod_group.status.phase.value
+                        for j in ssn.jobs.values())
+        # only one fits under the 4-cpu capability
+        assert phases == ["Inqueue", "Pending"]
+        close_session(ssn)
+
+
+class TestDRF:
+    def test_job_order_prefers_lower_share(self):
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "10", "memory": "10Gi"})],
+            [build_pod_group("pg1"), build_pod_group("pg2")],
+            # pg1 has 4 cpu running (share 0.4), pg2 has 1 cpu (share 0.1)
+            [build_pod("default", "a0", "n1", "Running",
+                       {"cpu": "4", "memory": "1Gi"}, "pg1"),
+             build_pod("default", "b0", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "pg2"),
+             build_pod("default", "a1", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "pg1"),
+             build_pod("default", "b1", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "pg2")])
+        tiers = [Tier(plugins=[PluginOption(name="drf")])]
+        ssn = open_session(cache, tiers)
+        j1, j2 = ssn.jobs["default/pg1"], ssn.jobs["default/pg2"]
+        assert ssn.job_order_fn(j2, j1)  # pg2 (lower share) first
+        drf = ssn.plugins["drf"]
+        assert drf.job_attrs[j1.uid].share == pytest.approx(0.4)
+        assert drf.job_attrs[j1.uid].dominant_resource == "cpu"
+        close_session(ssn)
+
+    def test_share_updates_on_allocate_events(self):
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "10", "memory": "10Gi"})],
+            [build_pod_group("pg1", min_member=1)],
+            [build_pod("default", "a0", "", "Pending",
+                       {"cpu": "5", "memory": "1Gi"}, "pg1")])
+        tiers = [Tier(plugins=[PluginOption(name="drf")])]
+        ssn = open_session(cache, tiers)
+        drf = ssn.plugins["drf"]
+        job = ssn.jobs["default/pg1"]
+        assert drf.job_attrs[job.uid].share == 0
+        task = next(iter(job.tasks.values()))
+        stmt = ssn.statement()
+        stmt.allocate(task, "n1")
+        assert drf.job_attrs[job.uid].share == pytest.approx(0.5)
+        stmt.discard()
+        assert drf.job_attrs[job.uid].share == 0
+        close_session(ssn)
+
+
+class TestHDRF:
+    def test_rescaling(self):
+        """hdrf_test.go 'rescaling test': 10-cpu/10G node; sci gets half,
+        eng's two children split the other half by dominant resource."""
+        queues = [
+            build_queue("root-sci", annotations={
+                "volcano.sh/hierarchy": "root/sci",
+                "volcano.sh/hierarchy-weights": "100/50"}),
+            build_queue("root-eng-dev", annotations={
+                "volcano.sh/hierarchy": "root/eng/dev",
+                "volcano.sh/hierarchy-weights": "100/50/50"}),
+            build_queue("root-eng-prod", annotations={
+                "volcano.sh/hierarchy": "root/eng/prod",
+                "volcano.sh/hierarchy-weights": "100/50/50"}),
+        ]
+        pgs = [build_pod_group("pg1", queue="root-sci", min_member=1),
+               build_pod_group("pg21", queue="root-eng-dev", min_member=1),
+               build_pod_group("pg22", queue="root-eng-prod", min_member=1)]
+        pods = []
+        for i in range(10):
+            pods.append(build_pod("default", f"pg1-p{i}", "", "Pending",
+                                  {"cpu": "1", "memory": "1G"}, "pg1"))
+            pods.append(build_pod("default", f"pg21-p{i}", "", "Pending",
+                                  {"cpu": "1", "memory": "0"}, "pg21"))
+            pods.append(build_pod("default", f"pg22-p{i}", "", "Pending",
+                                  {"cpu": "0", "memory": "1G"}, "pg22"))
+        nodes = [build_node("n", {"cpu": "10", "memory": "10G"})]
+        store, cache = make_cluster(nodes, pgs, pods, queues)
+        tiers = [Tier(plugins=[
+            PluginOption(name="drf",
+                         arguments={"drf.enableHierarchy": True}),
+            PluginOption(name="gang"),
+            PluginOption(name="predicates"),
+            PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers,
+                           [Configuration("allocate", {"mode": "host"})])
+        get_action("allocate").execute(ssn)
+        # tally allocated per job from binds
+        alloc = {}
+        for key, node in cache.binder.binds.items():
+            pod_name = key.split("/")[1]
+            pg = pod_name.rsplit("-p", 1)[0]
+            cpu, mem = (1000, 1e9) if pg == "pg1" else \
+                       ((1000, 0) if pg == "pg21" else (0, 1e9))
+            c, m = alloc.get(pg, (0, 0))
+            alloc[pg] = (c + cpu, m + mem)
+        assert alloc["pg1"] == (5000, 5e9)
+        assert alloc["pg21"][0] == 5000
+        assert alloc["pg22"][1] == 5e9
+        close_session(ssn)

@@ -191,58 +191,89 @@ class AllocateAction(Action):
     def _execute_host(self, ssn) -> None:
         from ..plugins.predicates import PredicateError
 
-        for job in self._ordered_jobs(ssn):
-            tasks = self._pending_tasks(ssn, job)
-            # The reference requeues a ready job with remaining tasks and
-            # continues it in a fresh statement; the inner loop below is the
-            # single-job equivalent (job interleaving differs, final
-            # placements match).
+        # Faithful control-flow port of allocate.go:124-265: the namespace
+        # loop pops one job per iteration, requeues a ready job with
+        # remaining tasks, and re-picks the queue each round so share-driven
+        # orders (drf/hdrf/proportion) steer every single placement.
+        namespaces = PriorityQueue(ssn.namespace_order_fn)
+        jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            if job.queue not in ssn.queues:
+                continue
+            ns = job.namespace
+            if ns not in jobs_map:
+                jobs_map[ns] = {}
+                namespaces.push(ns)
+            jobs_map[ns].setdefault(
+                job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+
+        pending_tasks: Dict[str, List] = {}
+        while not namespaces.empty():
+            ns = namespaces.pop()
+            queue_map = jobs_map[ns]
+            queue = None
+            for qname in list(queue_map):
+                qi = ssn.queues[qname]
+                if ssn.overused(qi):
+                    del queue_map[qname]
+                    continue
+                if queue is None or ssn.queue_order_fn(qi, queue):
+                    queue = qi
+            if queue is None:
+                continue
+            jobs = queue_map.get(queue.name)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+            if job.uid not in pending_tasks:
+                pending_tasks[job.uid] = self._pending_tasks(ssn, job)
+            tasks = pending_tasks[job.uid]
+
+            stmt = ssn.statement()
             while tasks:
-                stmt = ssn.statement()
-                progressed = False
-                stuck = False
-                while tasks:
-                    task = tasks.pop(0)
-                    fit_errors = FitErrors()
-                    candidates = []
-                    for node in ssn.nodes.values():
-                        try:
-                            self._predicate(ssn, task, node)
-                            candidates.append(node)
-                        except PredicateError as e:
-                            fit_errors.set_node_error(node.name, e.fit_error)
-                    if not candidates:
-                        job.nodes_fit_errors[task.key] = fit_errors
-                        stuck = True
-                        break
-                    candidates = [
-                        n for n in candidates
-                        if task.init_resreq.less_equal(n.idle)
-                        or task.init_resreq.less_equal(n.future_idle())]
-                    if not candidates:
-                        continue
-                    scores = {n.name: ssn.node_order_fn(task, n)
-                              for n in candidates}
-                    batch = ssn.batch_node_order_fn(task, candidates)
-                    for name, s in batch.items():
-                        scores[name] = scores.get(name, 0.0) + s
-                    best = ssn.best_node_fn(task, scores)
-                    if best is None:
-                        best = max(candidates, key=lambda n: scores[n.name])
-                    if task.init_resreq.less_equal(best.idle):
-                        stmt.allocate(task, best.name)
-                    else:
-                        ssn.pipeline(task, best.name)
-                    progressed = True
-                    if ssn.job_ready(job) and tasks:
-                        break
-                if ssn.job_ready(job):
-                    stmt.commit()
-                    if stuck or not progressed:
-                        break
-                else:
-                    stmt.discard()
+                task = tasks.pop(0)
+                fit_errors = FitErrors()
+                candidates = []
+                for node in ssn.nodes.values():
+                    try:
+                        self._predicate(ssn, task, node)
+                        candidates.append(node)
+                    except PredicateError as e:
+                        fit_errors.set_node_error(node.name, e.fit_error)
+                if not candidates:
+                    job.nodes_fit_errors[task.key] = fit_errors
                     break
+                candidates = [
+                    n for n in candidates
+                    if task.init_resreq.less_equal(n.idle)
+                    or task.init_resreq.less_equal(n.future_idle())]
+                if not candidates:
+                    continue
+                scores = {n.name: ssn.node_order_fn(task, n)
+                          for n in candidates}
+                batch = ssn.batch_node_order_fn(task, candidates)
+                for name, s in batch.items():
+                    scores[name] = scores.get(name, 0.0) + s
+                best = ssn.best_node_fn(task, scores)
+                if best is None:
+                    best = max(candidates, key=lambda n: scores[n.name])
+                if task.init_resreq.less_equal(best.idle):
+                    stmt.allocate(task, best.name)
+                else:
+                    ssn.pipeline(task, best.name)
+                if ssn.job_ready(job) and tasks:
+                    jobs.push(job)
+                    break
+            if ssn.job_ready(job):
+                stmt.commit()
+            else:
+                stmt.discard()
+            namespaces.push(ns)
 
     def execute(self, ssn) -> None:
         mode = "solver"

@@ -187,6 +187,24 @@ class Resource:
             self.scalars[k] = min(self.scalars[k], rr.scalars.get(k, 0.0))
         return self
 
+    def get(self, name: str) -> float:
+        if name == "cpu":
+            return self.milli_cpu
+        if name == "memory":
+            return self.memory
+        return self.scalars.get(name, 0.0)
+
+    def set(self, name: str, value: float) -> None:
+        if name == "cpu":
+            self.milli_cpu = value
+        elif name == "memory":
+            self.memory = value
+        else:
+            self.scalars[name] = value
+
+    def resource_names(self):
+        return ["cpu", "memory"] + list(self.scalars)
+
     # -- comparisons --------------------------------------------------------
 
     def _paired(self, rr: "Resource"):

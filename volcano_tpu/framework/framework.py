@@ -16,6 +16,7 @@ log = logging.getLogger(__name__)
 
 
 def open_session(cache, tiers: List[Tier], configurations=None) -> Session:
+    import volcano_tpu.plugins  # noqa: F401  (registers builtin plugins)
     ssn = Session(cache, cache.snapshot())
     ssn.tiers = tiers
     ssn.configurations = configurations or []

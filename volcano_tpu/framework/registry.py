@@ -21,6 +21,8 @@ def register_action(action) -> None:
 
 
 def get_action(name: str):
+    if name not in _actions:
+        import volcano_tpu.actions  # noqa: F401  (registers builtin actions)
     return _actions.get(name)
 
 
