@@ -1,0 +1,179 @@
+"""Preempt/reclaim/elect/reserve tests (reference actions/preempt/
+preempt_test.go, actions/reclaim/reclaim_test.go patterns)."""
+
+import pytest
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.framework import close_session, get_action, open_session
+from volcano_tpu.models import PriorityClass
+from volcano_tpu.utils.scheduler_helper import reservation
+
+from helpers import build_node, build_pod, build_pod_group, build_queue
+
+
+def make_cluster(nodes, podgroups, pods, queues=(), priority_classes=()):
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.run()
+    for pc in priority_classes:
+        store.create("priorityclasses", pc)
+    for q in queues:
+        store.apply("queues", q)
+    for n in nodes:
+        store.create("nodes", n)
+    for pg in podgroups:
+        store.create("podgroups", pg)
+    for p in pods:
+        store.create("pods", p)
+    return store, cache
+
+
+class TestPreempt:
+    def test_high_priority_job_preempts_within_queue(self):
+        """preempt_test.go case: node full with low-prio job; high-prio job
+        with pending tasks evicts victims and pipelines."""
+        low_pg = build_pod_group("low", "c1", min_member=1)
+        high_pg = build_pod_group("high", "c1", min_member=1)
+        high_pg.spec.priority_class_name = "high-priority"
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "2", "memory": "4Gi"})],
+            [low_pg, high_pg],
+            [build_pod("c1", "low-1", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "low"),
+             build_pod("c1", "low-2", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "low"),
+             build_pod("c1", "high-1", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "high")],
+            priority_classes=[PriorityClass("high-priority", 1000)])
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang"),
+                               PluginOption(name="conformance")]),
+                 Tier(plugins=[PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers)
+        get_action("preempt").execute(ssn)
+        assert len(cache.evictor.evicts) >= 1
+        assert all(e.startswith("c1/low") for e in cache.evictor.evicts)
+        high_job = ssn.jobs["c1/high"]
+        assert high_job.waiting_task_num() == 1  # pipelined
+        close_session(ssn)
+
+    def test_no_preemption_between_equal_priority(self):
+        pg_a = build_pod_group("a", "c1", min_member=1)
+        pg_b = build_pod_group("b", "c1", min_member=1)
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "2", "memory": "4Gi"})],
+            [pg_a, pg_b],
+            [build_pod("c1", "a-1", "n1", "Running",
+                       {"cpu": "2", "memory": "1Gi"}, "a"),
+             build_pod("c1", "b-1", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "b")])
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang"),
+                               PluginOption(name="conformance")])]
+        ssn = open_session(cache, tiers)
+        get_action("preempt").execute(ssn)
+        assert cache.evictor.evicts == []
+        close_session(ssn)
+
+    def test_conformance_protects_kube_system(self):
+        sys_pg = build_pod_group("sys", "kube-system", min_member=1)
+        high_pg = build_pod_group("high", "c1", min_member=1)
+        high_pg.spec.priority_class_name = "high-priority"
+        sys_pod = build_pod("kube-system", "sys-1", "n1", "Running",
+                            {"cpu": "2", "memory": "1Gi"}, "sys")
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "2", "memory": "4Gi"})],
+            [sys_pg, high_pg],
+            [sys_pod,
+             build_pod("c1", "high-1", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "high")],
+            priority_classes=[PriorityClass("high-priority", 1000)])
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang"),
+                               PluginOption(name="conformance")])]
+        ssn = open_session(cache, tiers)
+        get_action("preempt").execute(ssn)
+        assert cache.evictor.evicts == []
+        close_session(ssn)
+
+
+class TestReclaim:
+    def test_cross_queue_reclaim(self):
+        """reclaim_test.go: q2's starving job reclaims from q1 which exceeds
+        its deserved share."""
+        queues = [build_queue("q1", weight=1), build_queue("q2", weight=1)]
+        pg1 = build_pod_group("pg1", "c1", min_member=1, queue="q1")
+        pg2 = build_pod_group("pg2", "c1", min_member=1, queue="q2")
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "4", "memory": "4Gi"})],
+            [pg1, pg2],
+            [build_pod("c1", f"a{i}", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "pg1")
+             for i in range(4)]
+            + [build_pod("c1", "b0", "", "Pending",
+                         {"cpu": "1", "memory": "1Gi"}, "pg2")],
+            queues=queues)
+        tiers = [Tier(plugins=[PluginOption(name="gang"),
+                               PluginOption(name="conformance")]),
+                 Tier(plugins=[PluginOption(name="proportion"),
+                               PluginOption(name="predicates")])]
+        ssn = open_session(cache, tiers)
+        get_action("reclaim").execute(ssn)
+        assert len(cache.evictor.evicts) == 1
+        assert cache.evictor.evicts[0].startswith("c1/a")
+        job2 = ssn.jobs["c1/pg2"]
+        assert job2.waiting_task_num() == 1
+        close_session(ssn)
+
+    def test_non_reclaimable_queue_protected(self):
+        queues = [build_queue("q1", weight=1, reclaimable=False),
+                  build_queue("q2", weight=1)]
+        pg1 = build_pod_group("pg1", "c1", min_member=1, queue="q1")
+        pg2 = build_pod_group("pg2", "c1", min_member=1, queue="q2")
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "4", "memory": "4Gi"})],
+            [pg1, pg2],
+            [build_pod("c1", f"a{i}", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "pg1")
+             for i in range(4)]
+            + [build_pod("c1", "b0", "", "Pending",
+                         {"cpu": "1", "memory": "1Gi"}, "pg2")],
+            queues=queues)
+        tiers = [Tier(plugins=[PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="proportion"),
+                               PluginOption(name="predicates")])]
+        ssn = open_session(cache, tiers)
+        get_action("reclaim").execute(ssn)
+        assert cache.evictor.evicts == []
+        close_session(ssn)
+
+
+class TestElectReserve:
+    def test_elect_then_reserve_locks_node(self):
+        reservation.reset()
+        from volcano_tpu.models import PodGroupPhase
+        pg = build_pod_group("pg1", "c1", min_member=1,
+                             phase=PodGroupPhase.PENDING)
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "4", "memory": "8Gi"}),
+             build_node("n2", {"cpu": "8", "memory": "16Gi"})],
+            [pg],
+            [build_pod("c1", "p1", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "pg1")])
+        tiers = [Tier(plugins=[PluginOption(name="reservation"),
+                               PluginOption(name="gang")])]
+        ssn = open_session(cache, tiers)
+        get_action("elect").execute(ssn)
+        assert reservation.target_job is not None
+        assert reservation.target_job.name == "pg1"
+        get_action("reserve").execute(ssn)
+        # max-idle node locked
+        assert "n2" in reservation.locked_nodes
+        close_session(ssn)
+        reservation.reset()

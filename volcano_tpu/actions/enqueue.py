@@ -30,7 +30,10 @@ class EnqueueAction(Action):
         queue_set = set()
         jobs_map = {}
 
+        import time
         for job in ssn.jobs.values():
+            if job.schedule_start_timestamp is None:
+                job.schedule_start_timestamp = time.time()
             queue = ssn.queues.get(job.queue)
             if queue is None:
                 continue

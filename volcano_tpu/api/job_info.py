@@ -121,6 +121,7 @@ class JobInfo:
         self.pod_group = None
         self.priority_class_name = ""
         self.creation_timestamp = None
+        self.schedule_start_timestamp = None  # set by enqueue
 
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
@@ -223,6 +224,7 @@ class JobInfo:
         j.pod_group = self.pod_group
         j.priority_class_name = self.priority_class_name
         j.creation_timestamp = self.creation_timestamp
+        j.schedule_start_timestamp = self.schedule_start_timestamp
         j.job = self.job
         for ti in self.tasks.values():
             j.add_task_info(ti.clone())

@@ -1,3 +1,6 @@
 """Scheduler utilities (reference pkg/scheduler/util)."""
 
 from .priority_queue import PriorityQueue  # noqa: F401
+from .scheduler_helper import (  # noqa: F401
+    ResourceReservation, reservation, validate_victims,
+)

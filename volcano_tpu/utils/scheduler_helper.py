@@ -1,0 +1,43 @@
+"""Scheduler helpers (reference util/scheduler_helper.go).
+
+The predicate/score fan-out helpers of the reference became device kernels
+(volcano_tpu.ops); what remains host-side is victim validation and the
+global resource-reservation state shared by elect/reserve/allocate/enqueue.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api import NodeInfo, TaskInfo
+
+
+class ResourceReservation:
+    """Global reservation state (scheduler_helper.go:252-262)."""
+
+    def __init__(self):
+        self.target_job = None
+        self.locked_nodes: Dict[str, NodeInfo] = {}
+
+    def reset(self) -> None:
+        self.target_job = None
+        self.locked_nodes = {}
+
+
+#: module-level singleton, like the reference's util.Reservation
+reservation = ResourceReservation()
+
+
+def validate_victims(preemptor: TaskInfo, node: NodeInfo,
+                     victims: List[TaskInfo]) -> Optional[str]:
+    """Future idle plus victims' resources must fit the preemptor
+    (scheduler_helper.go:234-250). Returns an error string or None."""
+    if not victims:
+        return "no victims"
+    future_idle = node.future_idle()
+    for victim in victims:
+        future_idle.add(victim.resreq)
+    if not preemptor.init_resreq.less_equal(future_idle):
+        return (f"not enough resources: requested <{preemptor.init_resreq}>, "
+                f"but future idle <{future_idle}>")
+    return None
