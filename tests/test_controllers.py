@@ -1,0 +1,242 @@
+"""Controller tests (reference controllers/*_test.go patterns) + the full
+job lifecycle integration: submit Job CR -> controller creates
+podgroup/pods -> scheduler binds -> job Running."""
+
+import pytest
+
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.models import (
+    Action, Command, Event, Job, JobPhase, JobSpec, LifecyclePolicy,
+    PodGroupPhase, Queue, QueueState, TaskSpec,
+)
+from volcano_tpu.scheduler import Scheduler
+
+from helpers import build_node, build_queue
+
+
+def make_world():
+    store = ClusterStore()
+    cm = ControllerManager(store)
+    cm.run()
+    return store, cm
+
+
+def simple_job(name="job1", replicas=2, min_available=2, cpu="1",
+               plugins=None, policies=None, ttl=None):
+    return Job(
+        name=name, namespace="default",
+        spec=JobSpec(
+            min_available=min_available,
+            tasks=[TaskSpec(name="task", replicas=replicas, template={
+                "spec": {"containers": [
+                    {"name": "c", "requests": {"cpu": cpu, "memory": "1Gi"}}]},
+            })],
+            plugins=plugins or {},
+            policies=policies or [],
+            ttl_seconds_after_finished=ttl,
+        ))
+
+
+class TestJobController:
+    def test_sync_creates_podgroup_and_gates_pods(self):
+        store, cm = make_world()
+        store.create("jobs", simple_job())
+        cm.process_all()
+        pg = store.try_get("podgroups", "job1", "default")
+        assert pg is not None
+        assert pg.spec.min_member == 2
+        assert float(pg.spec.min_resources["cpu"]) == 2.0
+        # podgroup still Pending -> pods gated
+        assert store.list("pods") == []
+        # scheduler flips podgroup Inqueue -> pods created
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        pods = store.list("pods")
+        assert sorted(p.name for p in pods) == ["job1-task-0", "job1-task-1"]
+        assert all(p.annotations["scheduling.k8s.io/group-name"] == "job1"
+                   for p in pods)
+
+    def test_job_phase_running_then_completed(self):
+        store, cm = make_world()
+        store.create("jobs", simple_job())
+        cm.process_all()
+        pg = store.get("podgroups", "job1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        # simulate kubelet: pods run
+        for p in store.list("pods"):
+            p.phase = "Running"
+            store.update("pods", p)
+        cm.process_all()
+        job = store.get("jobs", "job1", "default")
+        assert job.status.state.phase == JobPhase.RUNNING
+        assert job.status.running == 2
+        # pods succeed
+        for p in store.list("pods"):
+            p.phase = "Succeeded"
+            store.update("pods", p)
+        cm.process_all()
+        job = store.get("jobs", "job1", "default")
+        assert job.status.state.phase == JobPhase.COMPLETED
+
+    def test_pod_failure_policy_restarts_job(self):
+        store, cm = make_world()
+        job = simple_job(policies=[
+            LifecyclePolicy(action=Action.RESTART_JOB,
+                            event=Event.POD_FAILED)])
+        store.create("jobs", job)
+        cm.process_all()
+        pg = store.get("podgroups", "job1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        pods = store.list("pods")
+        assert len(pods) == 2
+        pods[0].phase = "Failed"
+        store.update("pods", pods[0])
+        cm.process_all()
+        job = store.get("jobs", "job1", "default")
+        # Restarting kills pods, then transitions back to Pending; retry++
+        assert job.status.retry_count == 1
+        assert job.status.state.phase in (JobPhase.RESTARTING,
+                                          JobPhase.PENDING)
+
+    def test_abort_command(self):
+        store, cm = make_world()
+        store.create("jobs", simple_job())
+        cm.process_all()
+        pg = store.get("podgroups", "job1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        store.create("commands", Command(
+            name="abort-1", namespace="default", action=Action.ABORT_JOB,
+            target_object={"kind": "Job", "name": "job1"}))
+        cm.process_all()
+        job = store.get("jobs", "job1", "default")
+        assert job.status.state.phase in (JobPhase.ABORTING, JobPhase.ABORTED)
+        assert store.try_get("commands", "abort-1", "default") is None
+        # all pods killed
+        assert store.list("pods") == []
+
+    def test_scale_down_deletes_surplus_pods(self):
+        store, cm = make_world()
+        store.create("jobs", simple_job(replicas=3, min_available=1))
+        cm.process_all()
+        pg = store.get("podgroups", "job1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        assert len(store.list("pods")) == 3
+        job = store.get("jobs", "job1", "default")
+        job.spec.tasks[0].replicas = 1
+        store.update("jobs", job)
+        cm.process_all()
+        assert sorted(p.name for p in store.list("pods")) == ["job1-task-0"]
+
+    def test_svc_ssh_env_plugins(self):
+        store, cm = make_world()
+        store.create("jobs", simple_job(
+            plugins={"svc": [], "ssh": [], "env": []}))
+        cm.process_all()
+        pg = store.get("podgroups", "job1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        cmap = store.get("configmaps", "job1-svc", "default")
+        assert cmap.data["task.host"] == "job1-task-0.job1\njob1-task-1.job1"
+        assert store.get("services", "job1", "default").spec["clusterIP"] == "None"
+        secret = store.get("secrets", "job1-ssh", "default")
+        assert set(secret.data) >= {"id_rsa", "id_rsa.pub", "authorized_keys"}
+        pod = store.get("pods", "job1-task-1", "default")
+        envs = {e["name"]: e["value"] for e in pod.containers[0]["env"]}
+        assert envs["VC_TASK_INDEX"] == "1"
+
+
+class TestQueueController:
+    def test_queue_status_counts_and_close(self):
+        store, cm = make_world()
+        store.apply("queues", build_queue("q1"))
+        store.create("jobs", simple_job())
+        job2 = simple_job(name="job2")
+        job2.spec.queue = "q1"
+        store.create("jobs", job2)
+        cm.process_all()
+        q1 = store.get("queues", "q1")
+        assert q1.status.pending == 1
+        # close queue via command
+        store.create("commands", Command(
+            name="close-q1", namespace="default", action=Action.CLOSE_QUEUE,
+            target_object={"kind": "Queue", "name": "q1"}))
+        cm.process_all()
+        q1 = store.get("queues", "q1")
+        assert q1.status.state == QueueState.CLOSING  # podgroups remain
+
+
+class TestPodGroupController:
+    def test_bare_pod_gets_podgroup(self):
+        from volcano_tpu.models import Pod
+        store, cm = make_world()
+        pod = Pod(name="bare", namespace="default",
+                  containers=[{"requests": {"cpu": "1", "memory": "1Gi"}}])
+        store.create("pods", pod)
+        cm.process_all()
+        pod = store.get("pods", "bare", "default")
+        pg_name = pod.annotations["scheduling.k8s.io/group-name"]
+        pg = store.get("podgroups", pg_name, "default")
+        assert pg.spec.min_member == 1
+
+
+class TestGarbageCollector:
+    def test_ttl_expiry_cascades(self):
+        import time
+        store, cm = make_world()
+        job = simple_job(ttl=60, plugins={"svc": []})
+        store.create("jobs", job)
+        cm.process_all()
+        pg = store.get("podgroups", "job1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        for p in store.list("pods"):
+            p.phase = "Succeeded"
+            store.update("pods", p)
+        cm.process_all()
+        job = store.get("jobs", "job1", "default")
+        assert job.status.state.phase == JobPhase.COMPLETED
+        gc = cm.controllers[-1]
+        gc.process_all(now=time.time() + 30)  # not yet expired
+        assert store.try_get("jobs", "job1", "default") is not None
+        gc.process_all(now=time.time() + 61)
+        assert store.try_get("jobs", "job1", "default") is None
+        assert store.try_get("podgroups", "job1", "default") is None
+        assert store.try_get("configmaps", "job1-svc", "default") is None
+
+
+class TestFullLifecycle:
+    def test_submit_schedule_run(self):
+        """Job CR -> controllers create podgroup+pods -> scheduler enqueues,
+        allocates and binds -> pods Running -> job Running."""
+        store = ClusterStore()
+        cm = ControllerManager(store)
+        cm.run()
+        cache = SchedulerCache(store)
+        sched = Scheduler(cache)
+        for i in range(2):
+            store.create("nodes", build_node(f"n{i}",
+                                             {"cpu": "4", "memory": "8Gi"}))
+        store.create("jobs", simple_job(replicas=3, min_available=3))
+        cm.process_all()          # podgroup created (Pending), pods gated
+        sched.run(stop_after=1)   # enqueue flips Inqueue
+        cm.process_all()          # pods created
+        assert len(store.list("pods")) == 3
+        sched.run(stop_after=1)   # allocate binds; default binder runs pods
+        cm.process_all()          # job controller observes running pods
+        job = store.get("jobs", "job1", "default")
+        assert job.status.state.phase == JobPhase.RUNNING
+        pods = store.list("pods")
+        assert all(p.node_name for p in pods)

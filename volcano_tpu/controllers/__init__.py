@@ -1,0 +1,43 @@
+"""Controllers (reference pkg/controllers).
+
+ControllerManager wires every controller to a ClusterStore and drains them;
+the reference runs them under leader election in controller-manager.
+"""
+
+from .apis import JobInfo, Request  # noqa: F401
+from .framework import (  # noqa: F401
+    Controller, ControllerOption, register_controller,
+)
+from .garbagecollector import GarbageCollector  # noqa: F401
+from .job import JobController  # noqa: F401
+from .podgroup import PodGroupController  # noqa: F401
+from .queue import QueueController  # noqa: F401
+
+
+class ControllerManager:
+    """cmd/controller-manager equivalent: initialize + run all controllers
+    against one cluster store; process_all() drains every controller's
+    queue (single-core stand-in for the per-controller worker loops)."""
+
+    def __init__(self, cluster, scheduler_name: str = "volcano",
+                 worker_num: int = 3):
+        self.opt = ControllerOption(cluster=cluster,
+                                    scheduler_name=scheduler_name,
+                                    worker_num=worker_num)
+        self.controllers = [
+            JobController(),
+            QueueController(),
+            PodGroupController(),
+            GarbageCollector(),
+        ]
+        for ctrl in self.controllers:
+            ctrl.initialize(self.opt)
+
+    def run(self) -> None:
+        for ctrl in self.controllers:
+            ctrl.run()
+
+    def process_all(self, rounds: int = 4) -> None:
+        for _ in range(rounds):
+            for ctrl in self.controllers:
+                ctrl.process_all()

@@ -41,11 +41,11 @@ class JobUpdater:
     def update_job(self, job) -> None:
         if job.pod_group is None:
             return
-        import copy
-        old = copy.deepcopy(job.pod_group.status)
         new = job_status(self.ssn, job)
-        update_pg = not (_status_equal(old, new)
-                         and _conditions_equal(old.conditions, new.conditions))
+        old = self.ssn.pod_group_status.get(job.uid)
+        update_pg = old is None or not (
+            _status_equal(old, new)
+            and _conditions_equal(old.conditions, new.conditions))
         try:
             self.ssn.cache.update_job_status(job, update_pg)
         except Exception:

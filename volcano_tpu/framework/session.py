@@ -71,6 +71,15 @@ class Session:
         self.configurations = []  # per-action args
         self.plugins = {}        # name -> Plugin instance
 
+        # status of every PodGroup at session open; the job updater diffs
+        # end-of-session status against this to decide writes
+        # (job_updater.go:95-100 ssn.podGroupStatus)
+        import copy
+        self.pod_group_status = {
+            uid: copy.deepcopy(job.pod_group.status)
+            for uid, job in self.jobs.items() if job.pod_group is not None
+        }
+
         for reg in FN_REGISTRIES:
             setattr(self, reg, {})
         self.event_handlers: List[EventHandler] = []

@@ -1,7 +1,21 @@
-"""JAX/TPU kernels: snapshot flattening, feasibility, scoring, solvers."""
+"""JAX/TPU kernels: snapshot flattening, feasibility, scoring, solvers.
+
+Solver imports are lazy (PEP 562) so the pure-Python control plane
+(controllers, webhooks, CLI, cache) never pays jax/PJRT initialization —
+jax loads on the first actual solve.
+"""
 
 from .arrays import ScoreParams, SnapshotArrays, bucket, flatten_snapshot  # noqa: F401
-from .solver import (  # noqa: F401
-    SolveResult, fits_matrix, score_matrix, solve_allocate,
-    solve_allocate_sequential,
-)
+
+_LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
+         "solve_allocate_sequential")
+
+__all__ = ["ScoreParams", "SnapshotArrays", "bucket", "flatten_snapshot",
+           *_LAZY]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import solver
+        return getattr(solver, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
