@@ -1,0 +1,209 @@
+"""Admission webhook + CLI tests (reference admit_job_test.go/mutate_job_test
+patterns, pkg/cli behavior)."""
+
+import pytest
+
+from volcano_tpu.cli import main as vcctl
+from volcano_tpu.client import AdmissionError, ClusterStore
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.models import (
+    Action, Event, Job, JobSpec, LifecyclePolicy, Pod, PodGroupPhase,
+    QueueState, TaskSpec,
+)
+from volcano_tpu.webhooks import start_webhooks
+
+from helpers import build_pod_group, build_queue
+
+
+def admission_world():
+    store = ClusterStore()
+    store.create("queues", build_queue("default"))
+    start_webhooks(store)
+    return store
+
+
+def valid_job(**kw):
+    spec = dict(
+        min_available=2,
+        tasks=[TaskSpec(name="task", replicas=2, template={
+            "spec": {"containers": [{"name": "c",
+                                     "requests": {"cpu": "1"}}]}})])
+    spec.update(kw)
+    return Job(name="j1", namespace="default", spec=JobSpec(**spec))
+
+
+class TestJobAdmission:
+    def test_valid_job_passes_and_is_defaulted(self):
+        store = admission_world()
+        job = valid_job(min_available=0)
+        job.spec.queue = ""
+        store.create("jobs", job)
+        saved = store.get("jobs", "j1", "default")
+        assert saved.spec.queue == "default"       # mutated
+        assert saved.spec.min_available == 2       # sum of replicas
+
+    def test_min_available_exceeds_replicas_rejected(self):
+        store = admission_world()
+        with pytest.raises(AdmissionError, match="minAvailable"):
+            store.create("jobs", valid_job(min_available=5))
+
+    def test_duplicate_task_names_rejected(self):
+        store = admission_world()
+        job = valid_job()
+        job.spec.tasks.append(TaskSpec(name="task", replicas=1, template={
+            "spec": {"containers": [{"name": "c"}]}}))
+        with pytest.raises(AdmissionError, match="duplicated task name"):
+            store.create("jobs", job)
+
+    def test_policy_event_and_exitcode_exclusive(self):
+        store = admission_world()
+        job = valid_job(policies=[LifecyclePolicy(
+            action=Action.RESTART_JOB, event=Event.POD_FAILED, exit_code=3)])
+        with pytest.raises(AdmissionError, match="simultaneously"):
+            store.create("jobs", job)
+
+    def test_no_tasks_rejected(self):
+        store = admission_world()
+        with pytest.raises(AdmissionError, match="No task"):
+            store.create("jobs", valid_job(tasks=[]))
+
+    def test_closed_queue_rejected(self):
+        store = admission_world()
+        q = build_queue("closed-q")
+        q.status.state = QueueState.CLOSED
+        store.create("queues", q)
+        with pytest.raises(AdmissionError, match="Open"):
+            store.create("jobs", valid_job(queue="closed-q"))
+
+    def test_update_only_replicas_minavailable(self):
+        import copy
+        store = admission_world()
+        store.create("jobs", valid_job())
+        # clients submit fresh objects; mutating the stored one in place
+        # would defeat old-vs-new comparison
+        job = copy.deepcopy(store.get("jobs", "j1", "default"))
+        job.spec.tasks[0].replicas = 3
+        job.spec.min_available = 1
+        store.update("jobs", job)  # allowed
+        job = copy.deepcopy(store.get("jobs", "j1", "default"))
+        job.spec.queue = "other"
+        with pytest.raises(AdmissionError, match="may not change"):
+            store.update("jobs", job)
+
+    def test_unknown_plugin_rejected(self):
+        store = admission_world()
+        with pytest.raises(AdmissionError, match="job plugin"):
+            store.create("jobs", valid_job(plugins={"nope": []}))
+
+
+class TestPodGate:
+    def test_pod_rejected_while_podgroup_pending(self):
+        store = admission_world()
+        store.create("podgroups", build_pod_group(
+            "pg1", phase=PodGroupPhase.PENDING))
+        pod = Pod(name="p1", namespace="default",
+                  annotations={"scheduling.k8s.io/group-name": "pg1"},
+                  containers=[{"requests": {"cpu": "1"}}])
+        with pytest.raises(AdmissionError, match="Pending"):
+            store.create("pods", pod)
+        pg = store.get("podgroups", "pg1", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        store.create("pods", pod)  # now admitted
+
+
+class TestQueueAdmission:
+    def test_weight_validated_and_defaulted(self):
+        store = admission_world()
+        # unset (0) weight is defaulted to 1 by mutation
+        q0 = build_queue("q0")
+        q0.spec.weight = 0
+        store.create("queues", q0)
+        assert store.get("queues", "q0").spec.weight == 1
+        # negative weight is rejected by validation
+        qneg = build_queue("qneg")
+        qneg.spec.weight = -2
+        with pytest.raises(AdmissionError, match="weight"):
+            store.create("queues", qneg)
+
+    def test_reclaimable_defaulted(self):
+        store = admission_world()
+        q = build_queue("qr")
+        assert q.spec.reclaimable is None
+        store.create("queues", q)
+        assert store.get("queues", "qr").spec.reclaimable is True
+
+    def test_hierarchy_depth_mismatch_rejected(self):
+        store = admission_world()
+        q = build_queue("qh", annotations={
+            "volcano.sh/hierarchy": "root/a/b",
+            "volcano.sh/hierarchy-weights": "1/2"})
+        with pytest.raises(AdmissionError, match="depth"):
+            store.create("queues", q)
+
+    def test_delete_with_podgroups_rejected(self):
+        store = admission_world()
+        store.create("queues", build_queue("busy"))
+        store.create("podgroups", build_pod_group("pg1", queue="busy"))
+        with pytest.raises(AdmissionError, match="podgroup"):
+            store.delete("queues", "busy")
+
+    def test_default_queue_protected(self):
+        store = admission_world()
+        with pytest.raises(AdmissionError, match="default"):
+            store.delete("queues", "default")
+
+
+class TestCLI:
+    def _world(self):
+        store = ClusterStore()
+        store.create("queues", build_queue("default"))
+        start_webhooks(store)
+        cm = ControllerManager(store)
+        cm.run()
+        return store, cm
+
+    def test_job_run_list_view(self):
+        store, cm = self._world()
+        out = vcctl(["job", "run", "-N", "demo", "-r", "3", "-m", "2"],
+                    cluster=store)
+        assert "successfully" in out
+        cm.process_all()
+        out = vcctl(["job", "list"], cluster=store)
+        assert "demo" in out and "Pending" in out
+        out = vcctl(["job", "view", "-N", "demo"], cluster=store)
+        assert "MinAvailable:2" in out
+
+    def test_job_suspend_creates_abort_command(self):
+        store, cm = self._world()
+        vcctl(["job", "run", "-N", "demo"], cluster=store)
+        cm.process_all()
+        out = vcctl(["job", "suspend", "-N", "demo"], cluster=store)
+        assert "suspend" in out
+        cm.process_all()
+        job = store.get("jobs", "demo", "default")
+        assert job.status.state.phase in ("Aborting", "Aborted") or \
+            job.status.state.phase.value in ("Aborting", "Aborted")
+
+    def test_vsub_alias(self):
+        store, cm = self._world()
+        out = vcctl(["vsub", "-N", "alias-job"], cluster=store)
+        assert "successfully" in out
+        assert store.try_get("jobs", "alias-job", "default") is not None
+
+    def test_queue_lifecycle(self):
+        store, cm = self._world()
+        assert "successfully" in vcctl(
+            ["queue", "create", "-n", "q1", "-w", "3"], cluster=store)
+        out = vcctl(["queue", "list"], cluster=store)
+        assert "q1" in out
+        assert "close" in vcctl(
+            ["queue", "operate", "-n", "q1", "-a", "close"], cluster=store)
+        cm.process_all()
+        out = vcctl(["queue", "get", "-n", "q1"], cluster=store)
+        assert "Closed" in out or "Closing" in out
+        assert "delete" in vcctl(["queue", "delete", "-n", "q1"],
+                                 cluster=store)
+
+    def test_version(self):
+        assert "vcctl version" in vcctl(["version"])

@@ -1,0 +1,308 @@
+"""vcctl-equivalent CLI (reference cmd/cli/vcctl.go + pkg/cli/*).
+
+Commands: job {run,list,view,suspend,resume,delete},
+queue {create,delete,operate,list,get}, version. Operates against a
+ClusterStore (in production the gRPC sidecar to the control plane; in
+tests/dev an in-memory store). Standalone aliases vsub/vjobs/vqueues/
+vcancel/vsuspend/vresume map onto the same verbs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import List, Optional
+
+import yaml
+
+from .. import __version__
+from ..client.store import ClusterStore, NotFoundError
+from ..models import (
+    Action, Command, Job, JobSpec, Queue, QueueSpec, TaskSpec,
+)
+
+
+def _fmt_age(ts: float) -> str:
+    s = int(time.time() - ts)
+    if s < 60:
+        return f"{s}s"
+    if s < 3600:
+        return f"{s // 60}m"
+    return f"{s // 3600}h"
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    lines = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(headers))]
+    for row in rows:
+        lines.append("  ".join(str(c).ljust(widths[i])
+                               for i, c in enumerate(row)))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# job commands (pkg/cli/job)
+# ---------------------------------------------------------------------------
+
+def job_run(args, cluster: ClusterStore) -> str:
+    if args.filename:
+        with open(args.filename) as f:
+            raw = yaml.safe_load(f)
+        job = _job_from_yaml(raw)
+    else:
+        requests = {}
+        for kv in (args.requests or "").split(","):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                requests[k.strip()] = v.strip()
+        requests.setdefault("cpu", "1")
+        requests.setdefault("memory", "1Gi")
+        job = Job(
+            name=args.name, namespace=args.namespace,
+            spec=JobSpec(
+                min_available=args.min_available or args.replicas,
+                queue=args.queue,
+                scheduler_name=args.scheduler,
+                tasks=[TaskSpec(name="task", replicas=args.replicas,
+                                template={"spec": {"containers": [{
+                                    "name": args.name,
+                                    "image": args.image,
+                                    "requests": requests}]}})]))
+    cluster.create("jobs", job)
+    return f"run job {job.name} successfully"
+
+
+def _job_from_yaml(raw: dict) -> Job:
+    meta = raw.get("metadata", {})
+    spec = raw.get("spec", {})
+    tasks = []
+    for t in spec.get("tasks", []):
+        tasks.append(TaskSpec(name=t.get("name", ""),
+                              replicas=int(t.get("replicas", 1)),
+                              template=t.get("template", {})))
+    return Job(
+        name=meta.get("name", "job"),
+        namespace=meta.get("namespace", "default"),
+        spec=JobSpec(
+            min_available=int(spec.get("minAvailable", 0)),
+            queue=spec.get("queue", ""),
+            scheduler_name=spec.get("schedulerName", "volcano"),
+            tasks=tasks,
+            plugins=spec.get("plugins", {}) or {},
+        ))
+
+
+def job_list(args, cluster: ClusterStore) -> str:
+    jobs = cluster.list("jobs", namespace=args.namespace)
+    rows = []
+    for j in sorted(jobs, key=lambda x: x.name):
+        st = j.status
+        replicas = sum(t.replicas for t in j.spec.tasks)
+        rows.append([j.name, _fmt_age(j.creation_timestamp),
+                     str(replicas), str(j.spec.min_available),
+                     st.state.phase.value, str(st.pending), str(st.running),
+                     str(st.succeeded), str(st.failed), str(st.retry_count)])
+    return _table(["Name", "Age", "Replicas", "Min", "Phase", "Pending",
+                   "Running", "Succeeded", "Failed", "RetryCount"], rows)
+
+
+def job_view(args, cluster: ClusterStore) -> str:
+    try:
+        j = cluster.get("jobs", args.name, args.namespace)
+    except NotFoundError:
+        return f"Error: job {args.namespace}/{args.name} not found"
+    st = j.status
+    lines = [
+        f"Name:        {j.name}",
+        f"Namespace:   {j.namespace}",
+        f"Queue:       {j.spec.queue or 'default'}",
+        f"Scheduler:   {j.spec.scheduler_name}",
+        f"MinAvailable:{j.spec.min_available}",
+        f"Phase:       {st.state.phase.value}",
+        f"Version:     {st.version}",
+        f"RetryCount:  {st.retry_count}",
+        "Tasks:",
+    ]
+    for t in j.spec.tasks:
+        lines.append(f"  - {t.name}: replicas={t.replicas}")
+    lines.append(f"Status: pending={st.pending} running={st.running} "
+                 f"succeeded={st.succeeded} failed={st.failed}")
+    return "\n".join(lines)
+
+
+def _job_command(args, cluster: ClusterStore, action: Action, verb: str) -> str:
+    try:
+        job = cluster.get("jobs", args.name, args.namespace)
+    except NotFoundError:
+        return f"Error: job {args.namespace}/{args.name} not found"
+    cluster.create("commands", Command(
+        name=f"{verb}-{job.name}-{int(time.time() * 1000) % 100000}",
+        namespace=job.namespace, action=action,
+        target_object={"kind": "Job", "name": job.name, "uid": job.uid}))
+    return f"{verb} job {job.name} successfully"
+
+
+def job_suspend(args, cluster) -> str:
+    return _job_command(args, cluster, Action.ABORT_JOB, "suspend")
+
+
+def job_resume(args, cluster) -> str:
+    return _job_command(args, cluster, Action.RESUME_JOB, "resume")
+
+
+def job_delete(args, cluster) -> str:
+    try:
+        cluster.delete("jobs", args.name, args.namespace)
+    except NotFoundError:
+        return f"Error: job {args.namespace}/{args.name} not found"
+    return f"delete job {args.name} successfully"
+
+
+# ---------------------------------------------------------------------------
+# queue commands (pkg/cli/queue)
+# ---------------------------------------------------------------------------
+
+def queue_create(args, cluster: ClusterStore) -> str:
+    q = Queue(name=args.name, spec=QueueSpec(weight=args.weight))
+    cluster.create("queues", q)
+    return f"create queue {q.name} successfully"
+
+
+def queue_list(args, cluster: ClusterStore) -> str:
+    rows = []
+    for q in sorted(cluster.list("queues"), key=lambda x: x.name):
+        rows.append([q.name, str(q.spec.weight), q.status.state.value,
+                     str(q.status.inqueue), str(q.status.pending),
+                     str(q.status.running), str(q.status.unknown)])
+    return _table(["Name", "Weight", "State", "Inqueue", "Pending",
+                   "Running", "Unknown"], rows)
+
+
+def queue_get(args, cluster: ClusterStore) -> str:
+    try:
+        q = cluster.get("queues", args.name)
+    except NotFoundError:
+        return f"Error: queue {args.name} not found"
+    return _table(["Name", "Weight", "State", "Inqueue", "Pending",
+                   "Running", "Unknown"],
+                  [[q.name, str(q.spec.weight), q.status.state.value,
+                    str(q.status.inqueue), str(q.status.pending),
+                    str(q.status.running), str(q.status.unknown)]])
+
+
+def queue_operate(args, cluster: ClusterStore) -> str:
+    try:
+        q = cluster.get("queues", args.name)
+    except NotFoundError:
+        return f"Error: queue {args.name} not found"
+    if args.action:
+        action = (Action.OPEN_QUEUE if args.action == "open"
+                  else Action.CLOSE_QUEUE)
+        cluster.create("commands", Command(
+            name=f"{args.action}-{q.name}-{int(time.time() * 1000) % 100000}",
+            namespace="default", action=action,
+            target_object={"kind": "Queue", "name": q.name, "uid": q.uid}))
+        return f"{args.action} queue {q.name} successfully"
+    if args.weight is not None:
+        q.spec.weight = args.weight
+        cluster.update("queues", q)
+        return f"update queue {q.name} successfully"
+    return "Error: nothing to do; specify --action or --weight"
+
+
+def queue_delete(args, cluster: ClusterStore) -> str:
+    try:
+        cluster.delete("queues", args.name)
+    except NotFoundError:
+        return f"Error: queue {args.name} not found"
+    return f"delete queue {args.name} successfully"
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="vcctl",
+                                description="volcano_tpu command line client")
+    sub = p.add_subparsers(dest="group")
+
+    jobp = sub.add_parser("job")
+    jsub = jobp.add_subparsers(dest="verb")
+    run = jsub.add_parser("run")
+    run.add_argument("--name", "-N", default="job")
+    run.add_argument("--namespace", "-n", default="default")
+    run.add_argument("--image", "-i", default="busybox")
+    run.add_argument("--replicas", "-r", type=int, default=1)
+    run.add_argument("--min-available", "-m", type=int, default=0,
+                     dest="min_available")
+    run.add_argument("--requests", default="cpu=1,memory=1Gi")
+    run.add_argument("--scheduler", "-S", default="volcano")
+    run.add_argument("--queue", "-q", default="")
+    run.add_argument("--filename", "-f", default=None)
+    for verb in ("list",):
+        v = jsub.add_parser(verb)
+        v.add_argument("--namespace", "-n", default=None)
+    for verb in ("view", "suspend", "resume", "delete"):
+        v = jsub.add_parser(verb)
+        v.add_argument("--name", "-N", required=True)
+        v.add_argument("--namespace", "-n", default="default")
+
+    queuep = sub.add_parser("queue")
+    qsub = queuep.add_subparsers(dest="verb")
+    qc = qsub.add_parser("create")
+    qc.add_argument("--name", "-n", required=True)
+    qc.add_argument("--weight", "-w", type=int, default=1)
+    qsub.add_parser("list")
+    for verb in ("get", "delete"):
+        v = qsub.add_parser(verb)
+        v.add_argument("--name", "-n", required=True)
+    qo = qsub.add_parser("operate")
+    qo.add_argument("--name", "-n", required=True)
+    qo.add_argument("--weight", "-w", type=int, default=None)
+    qo.add_argument("--action", "-a", choices=["open", "close"], default=None)
+
+    sub.add_parser("version")
+    return p
+
+
+_DISPATCH = {
+    ("job", "run"): job_run,
+    ("job", "list"): job_list,
+    ("job", "view"): job_view,
+    ("job", "suspend"): job_suspend,
+    ("job", "resume"): job_resume,
+    ("job", "delete"): job_delete,
+    ("queue", "create"): queue_create,
+    ("queue", "list"): queue_list,
+    ("queue", "get"): queue_get,
+    ("queue", "operate"): queue_operate,
+    ("queue", "delete"): queue_delete,
+}
+
+#: standalone binary aliases (cmd/cli/{vsub,vjobs,...})
+ALIASES = {
+    "vsub": ["job", "run"],
+    "vjobs": ["job", "list"],
+    "vqueues": ["queue", "list"],
+    "vcancel": ["job", "delete"],
+    "vsuspend": ["job", "suspend"],
+    "vresume": ["job", "resume"],
+}
+
+
+def main(argv: List[str], cluster: Optional[ClusterStore] = None) -> str:
+    if cluster is None:
+        cluster = ClusterStore()
+    if argv and argv[0] in ALIASES:
+        argv = ALIASES[argv[0]] + argv[1:]
+    args = build_parser().parse_args(argv)
+    if args.group == "version":
+        return f"vcctl version {__version__}"
+    fn = _DISPATCH.get((args.group, getattr(args, "verb", None)))
+    if fn is None:
+        return build_parser().format_help()
+    return fn(args, cluster)
