@@ -8,7 +8,7 @@ jax loads on the first actual solve.
 from .arrays import ScoreParams, SnapshotArrays, bucket, flatten_snapshot  # noqa: F401
 
 _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
-         "solve_allocate_sequential")
+         "solve_allocate_sequential", "solve_allocate_packed")
 
 __all__ = ["ScoreParams", "SnapshotArrays", "bucket", "flatten_snapshot",
            *_LAZY]

@@ -99,6 +99,11 @@ def _node_affinity_match(affinity: Optional[dict], node) -> bool:
 
 def _signature(task: TaskInfo) -> str:
     pod = task.pod
+    if not pod.node_selector and pod.affinity is None and not pod.tolerations:
+        ports = pod.ports()
+        if not ports:
+            return ""  # unconstrained fast path (the common case)
+        return json.dumps({"ports": sorted(ports)})
     return json.dumps({
         "sel": sorted((pod.node_selector or {}).items()),
         "aff": pod.affinity,
@@ -193,6 +198,33 @@ class SnapshotArrays:
     def J(self) -> int:
         return self.job_min.shape[0]
 
+    def packed(self):
+        """Pack the solver arrays into one f32 buffer + one i32 buffer so the
+        per-session host->device transfer is two puts instead of ~20 (the
+        per-transfer latency through the device tunnel dominates at small
+        sizes). Returns (fbuf, ibuf, layout); feed to solve_allocate_packed.
+        """
+        d = self.device_dict()
+        fparts, iparts, layout = [], [], []
+        foff = ioff = 0
+        for k in sorted(d):
+            v = d[k]
+            if v.dtype == np.float32:
+                fparts.append(v.ravel())
+                layout.append((k, "f", foff, v.size, v.shape))
+                foff += v.size
+            elif v.dtype == np.bool_:
+                iparts.append(v.ravel().astype(np.int32))
+                layout.append((k, "b", ioff, v.size, v.shape))
+                ioff += v.size
+            else:
+                iparts.append(v.ravel().astype(np.int32))
+                layout.append((k, "i", ioff, v.size, v.shape))
+                ioff += v.size
+        fbuf = np.concatenate(fparts) if fparts else np.zeros(0, np.float32)
+        ibuf = np.concatenate(iparts) if iparts else np.zeros(0, np.int32)
+        return fbuf, ibuf, tuple(layout)
+
     def device_dict(self) -> Dict[str, np.ndarray]:
         """The arrays the solver kernel consumes (one host->device hop)."""
         return {
@@ -270,12 +302,34 @@ def flatten_snapshot(
     arr.task_counts_ready = np.zeros(T, dtype=bool)
     arr.task_valid = np.zeros(T, dtype=bool)
 
+    n_tasks = len(tasks_in_order)
+    if n_tasks:
+        # bulk columns (vectorized: the per-session flatten is on the
+        # critical path of every cycle)
+        arr.task_init_req[:n_tasks, 0] = np.fromiter(
+            (t.init_resreq.milli_cpu for t in tasks_in_order), np.float32,
+            n_tasks)
+        arr.task_init_req[:n_tasks, 1] = np.fromiter(
+            (t.init_resreq.memory for t in tasks_in_order), np.float32,
+            n_tasks)
+        arr.task_req[:n_tasks, 0] = np.fromiter(
+            (t.resreq.milli_cpu for t in tasks_in_order), np.float32, n_tasks)
+        arr.task_req[:n_tasks, 1] = np.fromiter(
+            (t.resreq.memory for t in tasks_in_order), np.float32, n_tasks)
+        arr.task_job[:n_tasks] = np.fromiter(
+            (job_index[t.job] for t in tasks_in_order), np.int32, n_tasks)
+        arr.task_valid[:n_tasks] = True
     sigs: Dict[str, int] = {}
     sig_tasks: List[TaskInfo] = []
     for i, t in enumerate(tasks_in_order):
-        arr.task_init_req[i] = t.init_resreq.to_vector(vocab)
-        arr.task_req[i] = t.resreq.to_vector(vocab)
-        arr.task_job[i] = job_index[t.job]
+        for name, v in t.init_resreq.scalars.items():
+            idx = vocab.index(name)
+            if idx is not None:
+                arr.task_init_req[i, idx] = v
+        for name, v in t.resreq.scalars.items():
+            idx = vocab.index(name)
+            if idx is not None:
+                arr.task_req[i, idx] = v
         s = _signature(t)
         if s not in sigs:
             sigs[s] = len(sigs)
@@ -283,7 +337,6 @@ def flatten_snapshot(
         arr.task_sig[i] = sigs[s]
         # best-effort pending tasks already count in ready_task_num
         arr.task_counts_ready[i] = not t.init_resreq.is_empty()
-        arr.task_valid[i] = True
 
     arr.job_min = np.zeros(J, dtype=np.int32)
     arr.job_ready_base = np.zeros(J, dtype=np.int32)
@@ -308,17 +361,55 @@ def flatten_snapshot(
     arr.node_npods = np.zeros(N, dtype=np.int32)
     arr.node_max_pods = np.zeros(N, dtype=np.int32)
     arr.node_valid = np.zeros(N, dtype=bool)
-    for i, ni in enumerate(nodes_list):
-        arr.node_idle[i] = ni.idle.to_vector(vocab)
-        fut = ni.releasing.to_vector(vocab) - ni.pipelined.to_vector(vocab)
-        arr.node_extra_future[i] = fut
-        arr.node_used[i] = ni.used.to_vector(vocab)
-        alloc = ni.allocatable.to_vector(vocab)
-        arr.node_alloc[i] = np.where(alloc > 0, alloc, 1.0)
-        arr.node_npods[i] = len([
-            t for t in ni.tasks.values() if t.status != TaskStatus.PIPELINED])
-        arr.node_max_pods[i] = ni.allocatable.max_task_num or 1 << 30
-        arr.node_valid[i] = True
+    n_nodes = len(nodes_list)
+    if n_nodes:
+        for col, attr in ((arr.node_idle, "idle"), (arr.node_used, "used")):
+            col[:n_nodes, 0] = np.fromiter(
+                (getattr(n, attr).milli_cpu for n in nodes_list), np.float32,
+                n_nodes)
+            col[:n_nodes, 1] = np.fromiter(
+                (getattr(n, attr).memory for n in nodes_list), np.float32,
+                n_nodes)
+        arr.node_extra_future[:n_nodes, 0] = np.fromiter(
+            (n.releasing.milli_cpu - n.pipelined.milli_cpu
+             for n in nodes_list), np.float32, n_nodes)
+        arr.node_extra_future[:n_nodes, 1] = np.fromiter(
+            (n.releasing.memory - n.pipelined.memory for n in nodes_list),
+            np.float32, n_nodes)
+        alloc_cpu = np.fromiter(
+            (n.allocatable.milli_cpu for n in nodes_list), np.float32, n_nodes)
+        alloc_mem = np.fromiter(
+            (n.allocatable.memory for n in nodes_list), np.float32, n_nodes)
+        arr.node_alloc[:n_nodes, 0] = np.where(alloc_cpu > 0, alloc_cpu, 1.0)
+        arr.node_alloc[:n_nodes, 1] = np.where(alloc_mem > 0, alloc_mem, 1.0)
+        arr.node_npods[:n_nodes] = np.fromiter(
+            (sum(1 for t in n.tasks.values()
+                 if t.status != TaskStatus.PIPELINED) for n in nodes_list),
+            np.int32, n_nodes)
+        arr.node_max_pods[:n_nodes] = np.fromiter(
+            (n.allocatable.max_task_num or 1 << 30 for n in nodes_list),
+            np.int32, n_nodes)
+        arr.node_valid[:n_nodes] = True
+        if len(vocab) > 2:
+            for i, ni in enumerate(nodes_list):
+                for res, col in ((ni.idle, arr.node_idle),
+                                 (ni.used, arr.node_used)):
+                    for name, v in res.scalars.items():
+                        idx = vocab.index(name)
+                        if idx is not None:
+                            col[i, idx] = v
+                for name, v in ni.allocatable.scalars.items():
+                    idx = vocab.index(name)
+                    if idx is not None and v > 0:
+                        arr.node_alloc[i, idx] = v
+                for name, v in ni.releasing.scalars.items():
+                    idx = vocab.index(name)
+                    if idx is not None:
+                        arr.node_extra_future[i, idx] += v
+                for name, v in ni.pipelined.scalars.items():
+                    idx = vocab.index(name)
+                    if idx is not None:
+                        arr.node_extra_future[i, idx] -= v
 
     S = max(len(sigs), 1)
     arr.sig_masks = np.zeros((S, N), dtype=bool)

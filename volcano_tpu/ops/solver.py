@@ -458,3 +458,35 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
         & a["job_valid"]
     return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
                        rounds=jnp.int32(T))
+
+
+# ---------------------------------------------------------------------------
+# packed-transfer entry point
+# ---------------------------------------------------------------------------
+
+def _unpack(fbuf, ibuf, layout):
+    d = {}
+    for k, kind, off, size, shape in layout:
+        if kind == "f":
+            d[k] = jax.lax.dynamic_slice(fbuf, (off,), (size,)).reshape(shape)
+        else:
+            v = jax.lax.dynamic_slice(ibuf, (off,), (size,)).reshape(shape)
+            d[k] = v.astype(bool) if kind == "b" else v
+    return d
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
+    "score_families"))
+def solve_allocate_packed(fbuf, ibuf, layout,
+                          score_params: Dict[str, jnp.ndarray],
+                          max_rounds: int = 64,
+                          max_gang_iters: int = 8,
+                          per_node_cap: int = 0,
+                          herd_mode: str = "pack",
+                          score_families: Tuple[str, ...] = ("binpack",)) -> SolveResult:
+    """solve_allocate over buffers produced by SnapshotArrays.packed():
+    the unpack is free on device (slices fuse), the transfer is 2 puts."""
+    arrays = _unpack(fbuf, ibuf, layout)
+    return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
+                          per_node_cap, herd_mode, score_families)

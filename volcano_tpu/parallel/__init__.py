@@ -1,0 +1,3 @@
+"""Device-mesh sharding of the solver (the multi-chip scale axis)."""
+
+from .sharded_solver import make_mesh, solve_allocate_sharded  # noqa: F401

@@ -1,0 +1,276 @@
+"""Node-axis-sharded allocate solver: shard_map over a device mesh.
+
+Scaling axis (SURVEY.md §5.7-5.8): the reference bounds per-task work on
+big clusters by sampling nodes; the TPU build shards the node axis of the
+task x node problem across the mesh instead. Layout:
+
+- node arrays ([N,R] idle/used/alloc, [N] npods/valid, sig_masks[S,N]) are
+  sharded along the mesh 'n' axis;
+- task/job arrays ([T,*], [J]) are replicated;
+- each device computes feasibility/scores for its node shard only (the
+  [T, N/D] matrices are the memory hog), admission prefix-sums run
+  node-locally, and the small cross-device exchanges are [N] score/slot
+  vectors (all_gather) and [T] choice/admit vectors (psum/pmax) over ICI.
+
+The gang fixpoint and round loop conditions depend only on replicated
+values, so every device executes identical trip counts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.solver import (
+    NEG, BIG_KEY, SolveResult, _segment_prefix, score_matrix,
+)
+
+
+def make_mesh(devices=None, axis: str = "n") -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def _fits_local(req, avail, thr, scalar_mask):
+    lhs = req[:, None, :]
+    rhs = avail[None, :, :] + thr[None, None, :]
+    dim_ok = lhs < rhs
+    ignored = scalar_mask[None, None, :] & (lhs <= 10.0)
+    return jnp.all(dim_ok | ignored, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "max_rounds",
+                                             "max_gang_iters", "herd_mode",
+                                             "score_families"))
+def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
+                           score_params: Dict[str, jnp.ndarray],
+                           mesh: Mesh,
+                           max_rounds: int = 64,
+                           max_gang_iters: int = 8,
+                           herd_mode: str = "pack",
+                           score_families: Tuple[str, ...] = ("binpack",)) -> SolveResult:
+    a = arrays
+    T = a["task_init_req"].shape[0]
+    N = a["node_idle"].shape[0]
+    J = a["job_min"].shape[0]
+    D = mesh.devices.size
+    assert N % D == 0, f"node axis {N} must divide device count {D}"
+    thr = a["thresholds"]
+    scalar_mask = a["scalar_dim_mask"]
+    counts_ready = a["task_counts_ready"].astype(jnp.int32)
+    rank = a["task_rank"]
+
+    node_sharded = P(None, ) if False else P("n")
+    in_specs = {
+        "task_init_req": P(), "task_req": P(), "task_job": P(),
+        "task_rank": P(), "task_sig": P(), "task_counts_ready": P(),
+        "task_valid": P(), "job_min": P(), "job_ready_base": P(),
+        "job_queue": P(), "job_valid": P(),
+        "node_idle": P("n", None), "node_extra_future": P("n", None),
+        "node_used": P("n", None), "node_alloc": P("n", None),
+        "node_npods": P("n"), "node_max_pods": P("n"), "node_valid": P("n"),
+        "sig_masks": P(None, "n"), "thresholds": P(), "scalar_dim_mask": P(),
+    }
+    params_spec = {k: (P("n") if k == "node_static" else P())
+                   for k in score_params}
+
+    def kernel(a, sp):
+        axis_idx = jax.lax.axis_index("n")
+        n_loc = a["node_idle"].shape[0]
+        my_base = axis_idx * n_loc
+        sig_feas = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
+
+        def choose(eligible, avail, idle, npods):
+            """Global choice per task: local scoring + cross-device argmax,
+            with the waterfall herd spread computed on gathered [N]
+            vectors."""
+            pods_ok = (npods < a["node_max_pods"])[None, :]
+            feas = (_fits_local(a["task_init_req"], avail, thr, scalar_mask)
+                    & sig_feas & pods_ok & eligible[:, None])
+            used_now = a["node_used"] + (a["node_idle"] - idle)
+            score = score_matrix(a["task_init_req"], avail, used_now,
+                                 a["node_alloc"], sp, score_families)
+            masked = jnp.where(feas, score, NEG)
+
+            # personal best across devices
+            loc_val = jnp.max(masked, axis=1)                     # [T]
+            loc_idx = jnp.argmax(masked, axis=1).astype(jnp.int32) + my_base
+            vals = jax.lax.all_gather(loc_val, "n")               # [D,T]
+            idxs = jax.lax.all_gather(loc_idx, "n")               # [D,T]
+            best_dev = jnp.argmax(vals, axis=0)                   # [T]
+            personal = jnp.take_along_axis(
+                idxs, best_dev[None, :], axis=0)[0]               # [T]
+            has_any = jnp.max(vals, axis=0) > NEG / 2
+            personal = jnp.where(has_any, personal, -1)
+
+            if herd_mode in ("pack", "spread"):
+                node_score_loc = jnp.max(masked, axis=0)          # [N_loc]
+                n_elig = jnp.maximum(jnp.sum(eligible), 1)
+                mean_req = jnp.sum(a["task_init_req"] * eligible[:, None],
+                                   axis=0) / n_elig
+                sig = mean_req > jnp.where(scalar_mask, 10.0, 0.0)
+                slots_dim = jnp.where(
+                    sig[None, :],
+                    jnp.floor((avail + thr[None, :])
+                              / jnp.maximum(mean_req[None, :], 1e-9)),
+                    jnp.inf)
+                slots_loc = jnp.min(slots_dim, axis=1)
+                slots_loc = jnp.minimum(
+                    slots_loc, (a["node_max_pods"] - npods).astype(jnp.float32))
+                slots_loc = jnp.clip(slots_loc, 0.0, float(T))
+
+                node_score = jax.lax.all_gather(
+                    node_score_loc, "n", tiled=True)              # [N]
+                slots = jax.lax.all_gather(slots_loc, "n", tiled=True)
+                has_slot = slots > 0
+                order = jnp.argsort(-jnp.where(has_slot, node_score, NEG))
+                pos = jnp.cumsum(eligible.astype(jnp.int32)) - 1
+                if herd_mode == "spread":
+                    m = jnp.maximum(jnp.sum(has_slot), 1)
+                    target = order[jnp.mod(jnp.maximum(pos, 0), m)]
+                else:
+                    cum = jnp.cumsum(slots[order])
+                    idx = jnp.searchsorted(cum, pos.astype(jnp.float32),
+                                           side="right")
+                    target = order[jnp.clip(idx, 0, N - 1)]
+                target = target.astype(jnp.int32)
+                # feasibility of each task at its (possibly remote) target
+                t_loc = target - my_base
+                mine = (t_loc >= 0) & (t_loc < n_loc)
+                t_ok_loc = jnp.take_along_axis(
+                    feas, jnp.clip(t_loc, 0, n_loc - 1)[:, None],
+                    axis=1)[:, 0] & mine
+                t_ok = jax.lax.psum(t_ok_loc.astype(jnp.int32), "n") > 0
+                choice = jnp.where(t_ok, target, personal)
+            else:
+                choice = personal
+            return choice, feas
+
+        def admit_local(choice, feas, avail, npods):
+            """Admission for choices landing in this device's shard."""
+            c_loc = choice - my_base
+            mine = (c_loc >= 0) & (c_loc < n_loc) & (choice >= 0)
+            c_loc = jnp.where(mine, c_loc, -1)
+            key = jnp.where(mine, c_loc * (T + 1) + rank, BIG_KEY)
+            perm = jnp.argsort(key)
+            s_choice = c_loc[perm]
+            s_active = s_choice >= 0
+            s_fit = a["task_init_req"][perm] * s_active[:, None]
+            seg_start = jnp.concatenate(
+                [jnp.array([True]), s_choice[1:] != s_choice[:-1]])
+            prefix = _segment_prefix(s_fit, seg_start)
+            s_avail = avail[jnp.maximum(s_choice, 0)]
+            dim_ok = (prefix + s_fit) < (s_avail + thr[None, :])
+            ignored = scalar_mask[None, :] & (s_fit <= 10.0)
+            fits = jnp.all(dim_ok | ignored, axis=-1) & s_active
+            ones = jnp.ones_like(s_choice)
+            pos = _segment_prefix(
+                ones[:, None].astype(jnp.float32), seg_start)[:, 0]
+            pods_fit = (npods[jnp.maximum(s_choice, 0)] + pos) \
+                < a["node_max_pods"][jnp.maximum(s_choice, 0)]
+            admit_sorted = fits & pods_fit
+            admit = jnp.zeros(T, dtype=bool).at[perm].set(admit_sorted)
+            debit = jax.ops.segment_sum(
+                a["task_req"] * admit[:, None], jnp.maximum(c_loc, 0),
+                num_segments=n_loc)
+            pod_inc = jax.ops.segment_sum(
+                admit.astype(jnp.int32), jnp.maximum(c_loc, 0),
+                num_segments=n_loc)
+            # global admitted assignment: each task admitted on one device
+            new_assign = jax.lax.pmax(
+                jnp.where(admit, choice, -1), "n")                # [T]
+            return new_assign, debit, pod_inc
+
+        def phase_rounds(st, use_future):
+            def cond(s):
+                return s[-1] & (s[-2] < max_rounds)
+
+            def body(s):
+                idle, pipe, npods, assigned, kind, excluded, rounds, _ = s
+                avail = (idle + a["node_extra_future"] - pipe) if use_future \
+                    else idle
+                eligible = (a["task_valid"] & (assigned < 0)
+                            & ~excluded[a["task_job"]])
+                choice, feas = choose(eligible, avail, idle, npods)
+                new_assign, debit, pod_inc = admit_local(
+                    choice, feas, avail, npods)
+                got = new_assign >= 0
+                assigned = jnp.where(got, new_assign, assigned)
+                kind = jnp.where(got, jnp.int32(1 if use_future else 0), kind)
+                if use_future:
+                    pipe = pipe + debit
+                else:
+                    idle = idle - debit
+                    npods = npods + pod_inc
+                return (idle, pipe, npods, assigned, kind, excluded,
+                        rounds + 1, jnp.any(got))
+
+            out = jax.lax.while_loop(cond, body, st + (jnp.bool_(True),))
+            return out[:-1]
+
+        def gang_body(s):
+            idle, pipe, npods, assigned, kind, excluded, rounds, _, it = s
+            st = (idle, pipe, npods, assigned, kind, excluded, rounds)
+            st = phase_rounds(st, False)
+            st = phase_rounds(st, True)
+            idle, pipe, npods, assigned, kind, excluded, rounds = st
+            alloc_counts = jax.ops.segment_sum(
+                ((assigned >= 0) & (kind == 0)).astype(jnp.int32)
+                * counts_ready, a["task_job"], num_segments=J)
+            ready = ((a["job_ready_base"] + alloc_counts) >= a["job_min"]) \
+                & a["job_valid"]
+            has_alloc = jax.ops.segment_sum(
+                ((assigned >= 0) & (kind == 0)).astype(jnp.int32),
+                a["task_job"], num_segments=J) > 0
+            revert_job = ~ready & a["job_valid"] & ~excluded & has_alloc
+            revert_task = (revert_job[a["task_job"]] & (assigned >= 0)
+                           & (kind == 0))
+            # credit back to this shard's nodes only
+            rv_loc = jnp.where(revert_task, assigned - my_base, -1)
+            rv_mine = (rv_loc >= 0) & (rv_loc < n_loc)
+            credit = jax.ops.segment_sum(
+                a["task_req"] * rv_mine[:, None], jnp.maximum(rv_loc, 0),
+                num_segments=n_loc)
+            pod_credit = jax.ops.segment_sum(
+                rv_mine.astype(jnp.int32), jnp.maximum(rv_loc, 0),
+                num_segments=n_loc)
+            idle = idle + credit
+            npods = npods - pod_credit
+            assigned = jnp.where(revert_task, -1, assigned)
+            kind = jnp.where(revert_task, -1, kind)
+            excluded = excluded | revert_job
+            return (idle, pipe, npods, assigned, kind, excluded, rounds,
+                    jnp.any(revert_job), it + 1)
+
+        init = (a["node_idle"], jnp.zeros_like(a["node_idle"]),
+                a["node_npods"], jnp.full((T,), -1, jnp.int32),
+                jnp.full((T,), -1, jnp.int32), ~a["job_valid"],
+                jnp.int32(0), jnp.bool_(True), jnp.int32(0))
+        s = jax.lax.while_loop(
+            lambda s: s[-2] & (s[-1] < max_gang_iters), gang_body, init)
+        idle, pipe, npods, assigned, kind, excluded, rounds, _, _ = s
+        alloc_counts = jax.ops.segment_sum(
+            ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
+            a["task_job"], num_segments=J)
+        job_ready = ((a["job_ready_base"] + alloc_counts) >= a["job_min"]) \
+            & a["job_valid"]
+        return assigned, kind, job_ready, rounds
+
+    mapped = shard_map(
+        kernel, mesh=mesh,
+        in_specs=(in_specs, params_spec),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False)
+    assigned, kind, job_ready, rounds = mapped(dict(a), dict(score_params))
+    return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
+                       rounds=rounds)
