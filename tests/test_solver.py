@@ -226,3 +226,69 @@ class TestSolverScale:
         # both fully place every job (assignments may differ in order)
         assert (np.asarray(r1.assigned)[:12] >= 0).all()
         assert (np.asarray(r2.assigned)[:12] >= 0).all()
+
+
+class TestFlattenCache:
+    """Incremental flatten must be indistinguishable from a full flatten."""
+
+    def _assert_same(self, arr, jobs, nodes, tasks):
+        ref = flatten_snapshot(jobs, nodes, tasks)
+        for k, v in arr.device_dict().items():
+            ref_v = ref.device_dict()[k]
+            # cached vocab may be wider (it only grows); compare the
+            # common prefix of resource dims
+            if v.ndim == 2 and v.shape[1] >= ref_v.shape[1] > 0 \
+                    and k != "sig_masks":
+                assert np.array_equal(v[:, :ref_v.shape[1]], ref_v), k
+            else:
+                assert np.array_equal(v, ref_v), k
+
+    def test_warm_reuse_and_invalidation(self):
+        from volcano_tpu.ops import FlattenCache
+
+        jobs, nodes, tasks = make_problem(
+            [("n1", "8", "16Gi"), ("n2", "8", "16Gi")],
+            [("j1", 2, [("1", "1Gi")] * 2), ("j2", 1, [("2", "2Gi")])])
+        fc = FlattenCache()
+        arr0 = flatten_snapshot(jobs, nodes, tasks, cache=fc)
+        self._assert_same(arr0, jobs, nodes, tasks)
+
+        # warm, nothing changed: wholesale reuse, same contents
+        arr1 = flatten_snapshot(jobs, nodes, tasks, cache=fc)
+        assert arr1.task_init_req is arr0.task_init_req
+        self._assert_same(arr1, jobs, nodes, tasks)
+
+        # bind one task: job status + node accounting both change
+        job = jobs["ns/j1"]
+        t0 = tasks[0]
+        job.update_task_status(t0, TaskStatus.ALLOCATED)
+        nodes["n1"].add_task(t0)
+        remaining = [t for t in tasks if t is not t0]
+        arr2 = flatten_snapshot(jobs, nodes, remaining, cache=fc)
+        self._assert_same(arr2, jobs, nodes, remaining)
+        n1_idx = [n.name for n in arr2.nodes_list].index("n1")
+        assert arr2.node_idle[n1_idx, 0] == 7000.0  # 8 cores - 1 allocated
+
+    def test_vocab_growth_on_new_scalar(self):
+        from volcano_tpu.ops import FlattenCache
+        from volcano_tpu.api import JobInfo, TaskInfo
+
+        jobs, nodes, tasks = make_problem(
+            [("n1", "8", "16Gi")], [("j1", 1, [("1", "1Gi")])])
+        fc = FlattenCache()
+        flatten_snapshot(jobs, nodes, tasks, cache=fc)
+
+        # a GPU job arrives later: vocab must grow, blocks recompute
+        pg = build_pod_group("jg", "ns", min_member=1)
+        gjob = JobInfo("ns/jg", pg)
+        p = build_pod("ns", "jg-0", "", "Pending",
+                      {"cpu": "1", "memory": "1Gi", "nvidia.com/gpu": 2},
+                      "jg")
+        gt = TaskInfo(p)
+        gjob.add_task_info(gt)
+        jobs2 = dict(jobs)
+        jobs2[gjob.uid] = gjob
+        arr = flatten_snapshot(jobs2, nodes, tasks + [gt], cache=fc)
+        gi = arr.vocab.index("nvidia.com/gpu")
+        assert gi is not None
+        assert arr.task_init_req[1, gi] == 2000.0  # scalars are milli-units

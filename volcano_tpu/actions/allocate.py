@@ -114,7 +114,7 @@ class AllocateAction(Action):
 
         arr = flatten_snapshot(
             {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
-            queues=ssn.queues)
+            queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None))
 
         sp = ssn.score_params
         weights_fn = ssn.solver_options.get("binpack_vocab_weights")

@@ -69,7 +69,8 @@ class TaskInfo:
     """Per-pod scheduling record (job_info.go:36-114)."""
 
     __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
-                 "node_name", "status", "priority", "volume_ready", "pod")
+                 "node_name", "status", "priority", "volume_ready", "pod",
+                 "sig_cache")
 
     def __init__(self, pod):
         self.uid = pod.uid
@@ -83,6 +84,7 @@ class TaskInfo:
         self.pod = pod
         self.resreq = get_pod_resource_without_init_containers(pod)
         self.init_resreq = get_pod_resource_request(pod)
+        self.sig_cache = None  # memoized predicate signature (ops.arrays)
 
     def clone(self) -> "TaskInfo":
         t = TaskInfo.__new__(TaskInfo)
@@ -97,6 +99,7 @@ class TaskInfo:
         t.pod = self.pod
         t.resreq = self.resreq.clone()
         t.init_resreq = self.init_resreq.clone()
+        t.sig_cache = self.sig_cache
         return t
 
     @property
@@ -125,6 +128,9 @@ class JobInfo:
 
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        # bumped on any task-set/status/spec mutation; the snapshot
+        # flattener's per-job block cache keys on it (ops.arrays)
+        self.flat_version = 0
         self.allocated = Resource()
         self.total_request = Resource()
         self.nodes_fit_errors: Dict[str, FitErrors] = {}
@@ -137,6 +143,7 @@ class JobInfo:
     # -- podgroup binding ---------------------------------------------------
 
     def set_pod_group(self, pg) -> None:
+        self.flat_version += 1
         self.name = pg.name
         self.namespace = pg.namespace
         self.queue = pg.spec.queue
@@ -158,6 +165,7 @@ class JobInfo:
                 del self.task_status_index[ti.status]
 
     def add_task_info(self, ti: TaskInfo) -> None:
+        self.flat_version += 1
         self.tasks[ti.key] = ti
         self._add_to_index(ti)
         if allocated_status(ti.status):
@@ -173,6 +181,7 @@ class JobInfo:
         self.total_request.sub(task.resreq)
         del self.tasks[task.key]
         self._remove_from_index(task)
+        self.flat_version += 1
 
     def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
         """Delete + reinsert keeping index/aggregates consistent
@@ -228,6 +237,9 @@ class JobInfo:
         j.job = self.job
         for ti in self.tasks.values():
             j.add_task_info(ti.clone())
+        # a clone is the same logical state: carry the version so the
+        # per-session snapshot clone keeps the flatten cache warm
+        j.flat_version = self.flat_version
         return j
 
     def fit_message(self) -> str:

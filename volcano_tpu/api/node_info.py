@@ -37,6 +37,12 @@ class NodeInfo:
         self.capability = Resource()
         self.tasks: Dict[str, TaskInfo] = {}
         self.others: Dict[str, object] = {}
+        # bumped on any accounting mutation; the snapshot flattener's
+        # per-node row cache keys on it (ops.arrays)
+        self.flat_version = 0
+        # bumped only when the node spec changes (set_node): label/taint
+        # predicate masks key on this, so binds don't invalidate them
+        self.spec_version = 0
         if node is not None:
             self.set_node(node)
 
@@ -57,6 +63,8 @@ class NodeInfo:
     def set_node(self, node) -> None:
         """Rebuild resource views from node.allocatable, replaying held tasks
         (node_info.go:171-210)."""
+        self.flat_version += 1
+        self.spec_version += 1
         if not self._check_ready(node):
             # Keep self.node unset (reference keeps ni.Node nil) so held
             # tasks skip resource accounting until the node turns ready.
@@ -104,6 +112,7 @@ class NodeInfo:
                 f"task <{task.key}> already on different node <{task.node_name}>")
         if task.key in self.tasks:
             raise ValueError(f"task <{task.key}> already on node <{self.name}>")
+        self.flat_version += 1
         ti = task.clone()
         if self.node is not None:
             if ti.status == TaskStatus.RELEASING:
@@ -123,6 +132,7 @@ class NodeInfo:
         task = self.tasks.get(ti.key)
         if task is None:
             raise KeyError(f"failed to find task <{ti.key}> on host <{self.name}>")
+        self.flat_version += 1
         if self.node is not None:
             if task.status == TaskStatus.RELEASING:
                 self.releasing.sub(task.resreq)
@@ -153,6 +163,8 @@ class NodeInfo:
         n.others = dict(self.others)
         for k, t in self.tasks.items():
             n.tasks[k] = t.clone()
+        n.flat_version = self.flat_version
+        n.spec_version = self.spec_version
         return n
 
     def pods(self):

@@ -111,6 +111,11 @@ class SchedulerCache:
         self._err_tasks: List[TaskInfo] = []
         self._synced = False
 
+        # incremental snapshot-flatten state shared across sessions
+        # (ops.arrays.FlattenCache; versions on JobInfo/NodeInfo invalidate)
+        from ..ops.arrays import FlattenCache
+        self.flatten_cache = FlattenCache()
+
         self._create_default_queue()
 
     # -- startup ------------------------------------------------------------

@@ -90,6 +90,7 @@ class Session:
         from ..ops.arrays import ScoreParams
         self.score_params = ScoreParams()
         self.solver_options: Dict[str, object] = {}
+        self.flatten_cache = getattr(cache, "flatten_cache", None)
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)

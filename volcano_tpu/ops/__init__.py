@@ -5,13 +5,15 @@ Solver imports are lazy (PEP 562) so the pure-Python control plane
 jax loads on the first actual solve.
 """
 
-from .arrays import ScoreParams, SnapshotArrays, bucket, flatten_snapshot  # noqa: F401
+from .arrays import (  # noqa: F401
+    FlattenCache, ScoreParams, SnapshotArrays, bucket, flatten_snapshot,
+)
 
 _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
          "solve_allocate_sequential", "solve_allocate_packed")
 
-__all__ = ["ScoreParams", "SnapshotArrays", "bucket", "flatten_snapshot",
-           *_LAZY]
+__all__ = ["FlattenCache", "ScoreParams", "SnapshotArrays", "bucket",
+           "flatten_snapshot", *_LAZY]
 
 
 def __getattr__(name):

@@ -98,18 +98,25 @@ def _node_affinity_match(affinity: Optional[dict], node) -> bool:
 
 
 def _signature(task: TaskInfo) -> str:
+    s = task.sig_cache
+    if s is not None:
+        return s
     pod = task.pod
     if not pod.node_selector and pod.affinity is None and not pod.tolerations:
         ports = pod.ports()
         if not ports:
-            return ""  # unconstrained fast path (the common case)
-        return json.dumps({"ports": sorted(ports)})
-    return json.dumps({
-        "sel": sorted((pod.node_selector or {}).items()),
-        "aff": pod.affinity,
-        "tol": pod.tolerations,
-        "ports": sorted(pod.ports()),
-    }, sort_keys=True, default=str)
+            s = ""  # unconstrained fast path (the common case)
+        else:
+            s = json.dumps({"ports": sorted(ports)})
+    else:
+        s = json.dumps({
+            "sel": sorted((pod.node_selector or {}).items()),
+            "aff": pod.affinity,
+            "tol": pod.tolerations,
+            "ports": sorted(pod.ports()),
+        }, sort_keys=True, default=str)
+    task.sig_cache = s
+    return s
 
 
 @dataclass
@@ -252,12 +259,122 @@ class SnapshotArrays:
         }
 
 
+class FlattenCache:
+    """Incremental cross-session flatten state.
+
+    The reference deep-clones the whole cluster every cycle (cache.go:693-742,
+    one goroutine per job); the TPU build instead keeps the device-ready
+    columns warm across sessions and recomputes only what changed, keyed on
+    ``JobInfo.flat_version`` / ``NodeInfo.flat_version`` bumps. A cold cache
+    (or ``cache=None``) reproduces the full flatten; results are identical
+    either way because every entry is verified against the live objects'
+    versions and task-uid sequences before reuse.
+    """
+
+    def __init__(self, vocab: Optional[ResourceVocab] = None):
+        self.vocab = vocab
+        self.job_blocks: Dict[str, dict] = {}
+        self.node_rows: Dict[str, dict] = {}
+        self.sig_rows: Dict[str, tuple] = {}   # sig -> (node_key, row[N])
+        self._node_key: Optional[tuple] = None
+        self._node_buf: Optional[dict] = None
+        self._task_key: Optional[tuple] = None
+        self._task_buf: Optional[tuple] = None
+
+    # -- per-node rows ------------------------------------------------------
+
+    def node_row(self, ni: NodeInfo) -> dict:
+        vocab = self.vocab
+        R = len(vocab)
+        ent = self.node_rows.get(ni.name)
+        if ent is not None and ent["v"] == ni.flat_version and ent["R"] == R:
+            return ent
+        idle = ni.idle.to_vector(vocab)
+        used = ni.used.to_vector(vocab)
+        extra = ni.releasing.to_vector(vocab) - ni.pipelined.to_vector(vocab)
+        alloc = ni.allocatable.to_vector(vocab)
+        alloc = np.where(alloc > 0, alloc, 1.0).astype(np.float32)
+        npods = sum(1 for t in ni.tasks.values()
+                    if t.status != TaskStatus.PIPELINED)
+        ent = {"v": ni.flat_version, "R": R, "idle": idle, "used": used,
+               "extra": extra, "alloc": alloc, "npods": npods,
+               "maxp": ni.allocatable.max_task_num or 1 << 30}
+        self.node_rows[ni.name] = ent
+        return ent
+
+    # -- per-job task blocks ------------------------------------------------
+
+    def job_block(self, job: JobInfo, tasks: List[TaskInfo],
+                  uids: tuple) -> dict:
+        vocab = self.vocab
+        R = len(vocab)
+        ent = self.job_blocks.get(job.uid)
+        if (ent is not None and ent["v"] == job.flat_version
+                and ent["R"] == R and ent["uids"] == uids):
+            return ent
+        k = len(tasks)
+        init = np.zeros((k, R), dtype=np.float32)
+        req = np.zeros((k, R), dtype=np.float32)
+        counts = np.zeros(k, dtype=bool)
+        sig_uniq: List[str] = []
+        sig_reps: List[TaskInfo] = []
+        sig_idx: Dict[str, int] = {}
+        sig_local = np.zeros(k, dtype=np.int32)
+        for i, t in enumerate(tasks):
+            init[i] = t.init_resreq.to_vector(vocab)
+            req[i] = t.resreq.to_vector(vocab)
+            counts[i] = not t.init_resreq.is_empty()
+            s = _signature(t)
+            li = sig_idx.get(s)
+            if li is None:
+                li = sig_idx[s] = len(sig_uniq)
+                sig_uniq.append(s)
+                sig_reps.append(t)
+            sig_local[i] = li
+        ent = {"v": job.flat_version, "R": R, "uids": uids,
+               "init": init, "req": req, "counts": counts,
+               "sig_uniq": sig_uniq, "sig_reps": sig_reps,
+               "sig_local": sig_local, "min": job.min_available,
+               "ready": job.ready_task_num(), "queue": job.queue}
+        self.job_blocks[job.uid] = ent
+        return ent
+
+    # -- bounded size -------------------------------------------------------
+
+    def sweep(self, live_jobs, live_nodes, live_sigs) -> None:
+        """Drop entries for departed jobs/nodes/signatures once the maps grow
+        well past the live set, so a churny cluster can't grow the cache
+        unboundedly (job blocks pin task arrays and Pod refs)."""
+        if len(self.job_blocks) > 2 * len(live_jobs) + 64:
+            self.job_blocks = {k: v for k, v in self.job_blocks.items()
+                               if k in live_jobs}
+        if len(self.node_rows) > 2 * len(live_nodes) + 64:
+            self.node_rows = {k: v for k, v in self.node_rows.items()
+                              if k in live_nodes}
+        if len(self.sig_rows) > 2 * len(live_sigs) + 64:
+            self.sig_rows = {k: v for k, v in self.sig_rows.items()
+                             if k in live_sigs}
+
+    # -- vocab growth -------------------------------------------------------
+
+    def ensure_names(self, resources) -> None:
+        """Register any new scalar resource names (vocab only ever grows, so
+        previously cached entries stay valid names-wise; width changes are
+        caught by the per-entry R check)."""
+        vocab = self.vocab
+        for r in resources:
+            for name in r.scalars:
+                if vocab.index(name) is None:
+                    vocab.add(name)
+
+
 def flatten_snapshot(
     jobs: Dict[str, JobInfo],
     nodes: Dict[str, NodeInfo],
     tasks_in_order: List[TaskInfo],
     vocab: Optional[ResourceVocab] = None,
     queues: Optional[Dict[str, object]] = None,
+    cache: Optional[FlattenCache] = None,
 ) -> SnapshotArrays:
     """Flatten session state into padded arrays.
 
@@ -265,26 +382,60 @@ def flatten_snapshot(
     session's namespace/queue/job/task ordering (host-side comparator pass —
     the ordering semantics stay in Python, the math goes on device).
     Tasks must be grouped by job within the order.
+
+    Pass a persistent ``cache`` (the SchedulerCache owns one) to make the
+    per-session flatten incremental: unchanged jobs reuse their cached task
+    blocks, unchanged nodes their rows.
+
+    NOTE: with a persistent cache the returned arrays alias cache-owned
+    buffers that the NEXT flatten call may rewrite in place — they are valid
+    for the current session only. Callers that need to retain arrays across
+    sessions must copy them.
     """
-    if vocab is None:
+    if cache is None:
+        cache = FlattenCache(vocab)
+    elif vocab is not None and cache.vocab is None:
+        cache.vocab = vocab
+    if cache.vocab is None:
         resources = []
         for ni in nodes.values():
             resources.append(ni.allocatable)
         for t in tasks_in_order:
             resources.append(t.init_resreq)
-        vocab = ResourceVocab.collect(resources)
+        cache.vocab = ResourceVocab.collect(resources)
+    vocab = cache.vocab
 
-    R = len(vocab)
     nodes_list = [n for n in nodes.values() if n.ready]
-    N = bucket(max(len(nodes_list), 1))
-    T = bucket(max(len(tasks_in_order), 1))
+    n_tasks = len(tasks_in_order)
+    n_nodes = len(nodes_list)
 
+    # group tasks by job, preserving order
     job_keys: List[str] = []
     job_index: Dict[str, int] = {}
+    job_tasks: List[List[TaskInfo]] = []
     for t in tasks_in_order:
-        if t.job not in job_index:
-            job_index[t.job] = len(job_keys)
+        j = job_index.get(t.job)
+        if j is None:
+            j = job_index[t.job] = len(job_keys)
             job_keys.append(t.job)
+            job_tasks.append([])
+        job_tasks[j].append(t)
+
+    # vocab growth pre-pass: only entries about to recompute can introduce
+    # new names; scanning just those here is what keeps R stable below
+    for j, key in enumerate(job_keys):
+        ent = cache.job_blocks.get(key)
+        if ent is None or ent["v"] != jobs[key].flat_version:
+            cache.ensure_names(t.init_resreq for t in job_tasks[j])
+            cache.ensure_names(t.resreq for t in job_tasks[j])
+    for ni in nodes_list:
+        ent = cache.node_rows.get(ni.name)
+        if ent is None or ent["v"] != ni.flat_version:
+            cache.ensure_names((ni.allocatable,))
+    R = len(vocab)
+
+    N = bucket(max(n_nodes, 1))
+    T = bucket(max(n_tasks, 1))
     # +1 guarantees a padded (invalid) job slot: padded tasks point there so
     # the sequential solver's job-boundary logic never revisits a real job
     J = bucket(len(job_keys) + 1)
@@ -294,6 +445,20 @@ def flatten_snapshot(
     arr.nodes_list = nodes_list
     arr.jobs_list = [jobs[k] for k in job_keys]
 
+    # -- task/job side, assembled from per-job cached blocks ----------------
+    # wholesale fast path: if no job changed and the task sequence is
+    # identical (verified, not assumed), the previous session's assembled
+    # arrays are this session's too
+    task_wkey = (tuple(job_keys),
+                 tuple(jobs[k].flat_version for k in job_keys),
+                 tuple(t.uid for t in tasks_in_order), R, T, J)
+    if cache._task_key == task_wkey:
+        (arr.task_init_req, arr.task_req, arr.task_job, arr.task_rank,
+         arr.task_sig, arr.task_counts_ready, arr.task_valid,
+         arr.job_min, arr.job_ready_base, arr.job_queue, arr.job_valid,
+         sigs, sig_tasks, queue_index, queue_names) = cache._task_buf
+        return _finish(arr, cache, nodes_list, n_nodes, R, N, sigs,
+                       sig_tasks, queue_index, queue_names, queues)
     arr.task_init_req = np.zeros((T, R), dtype=np.float32)
     arr.task_req = np.zeros((T, R), dtype=np.float32)
     arr.task_job = np.full(T, J - 1, dtype=np.int32)  # padded job slot
@@ -301,122 +466,116 @@ def flatten_snapshot(
     arr.task_sig = np.zeros(T, dtype=np.int32)
     arr.task_counts_ready = np.zeros(T, dtype=bool)
     arr.task_valid = np.zeros(T, dtype=bool)
-
-    n_tasks = len(tasks_in_order)
-    if n_tasks:
-        # bulk columns (vectorized: the per-session flatten is on the
-        # critical path of every cycle)
-        arr.task_init_req[:n_tasks, 0] = np.fromiter(
-            (t.init_resreq.milli_cpu for t in tasks_in_order), np.float32,
-            n_tasks)
-        arr.task_init_req[:n_tasks, 1] = np.fromiter(
-            (t.init_resreq.memory for t in tasks_in_order), np.float32,
-            n_tasks)
-        arr.task_req[:n_tasks, 0] = np.fromiter(
-            (t.resreq.milli_cpu for t in tasks_in_order), np.float32, n_tasks)
-        arr.task_req[:n_tasks, 1] = np.fromiter(
-            (t.resreq.memory for t in tasks_in_order), np.float32, n_tasks)
-        arr.task_job[:n_tasks] = np.fromiter(
-            (job_index[t.job] for t in tasks_in_order), np.int32, n_tasks)
-        arr.task_valid[:n_tasks] = True
-    sigs: Dict[str, int] = {}
-    sig_tasks: List[TaskInfo] = []
-    for i, t in enumerate(tasks_in_order):
-        for name, v in t.init_resreq.scalars.items():
-            idx = vocab.index(name)
-            if idx is not None:
-                arr.task_init_req[i, idx] = v
-        for name, v in t.resreq.scalars.items():
-            idx = vocab.index(name)
-            if idx is not None:
-                arr.task_req[i, idx] = v
-        s = _signature(t)
-        if s not in sigs:
-            sigs[s] = len(sigs)
-            sig_tasks.append(t)
-        arr.task_sig[i] = sigs[s]
-        # best-effort pending tasks already count in ready_task_num
-        arr.task_counts_ready[i] = not t.init_resreq.is_empty()
-
     arr.job_min = np.zeros(J, dtype=np.int32)
     arr.job_ready_base = np.zeros(J, dtype=np.int32)
     arr.job_queue = np.zeros(J, dtype=np.int32)
     arr.job_valid = np.zeros(J, dtype=bool)
+
+    sigs: Dict[str, int] = {}
+    sig_tasks: List[TaskInfo] = []
     queue_index: Dict[str, int] = {}
     queue_names: List[str] = []
+    off = 0
     for j, key in enumerate(job_keys):
-        job = jobs[key]
-        arr.job_min[j] = job.min_available
-        arr.job_ready_base[j] = job.ready_task_num()
+        tasks = job_tasks[j]
+        k = len(tasks)
+        ent = cache.job_block(jobs[key], tasks, tuple(t.uid for t in tasks))
+        arr.task_init_req[off:off + k] = ent["init"]
+        arr.task_req[off:off + k] = ent["req"]
+        arr.task_counts_ready[off:off + k] = ent["counts"]
+        arr.task_job[off:off + k] = j
+        arr.task_valid[off:off + k] = True
+        remap = np.empty(max(len(ent["sig_uniq"]), 1), dtype=np.int32)
+        for li, s in enumerate(ent["sig_uniq"]):
+            gi = sigs.get(s)
+            if gi is None:
+                gi = sigs[s] = len(sig_tasks)
+                sig_tasks.append(ent["sig_reps"][li])
+            remap[li] = gi
+        arr.task_sig[off:off + k] = remap[ent["sig_local"]]
+        off += k
+
+        arr.job_min[j] = ent["min"]
+        arr.job_ready_base[j] = ent["ready"]
         arr.job_valid[j] = True
-        if job.queue not in queue_index:
-            queue_index[job.queue] = len(queue_names)
-            queue_names.append(job.queue)
-        arr.job_queue[j] = queue_index[job.queue]
+        q = ent["queue"]
+        if q not in queue_index:
+            queue_index[q] = len(queue_names)
+            queue_names.append(q)
+        arr.job_queue[j] = queue_index[q]
 
-    arr.node_idle = np.zeros((N, R), dtype=np.float32)
-    arr.node_extra_future = np.zeros((N, R), dtype=np.float32)
-    arr.node_used = np.zeros((N, R), dtype=np.float32)
-    arr.node_alloc = np.ones((N, R), dtype=np.float32)  # avoid div by 0 in pads
-    arr.node_npods = np.zeros(N, dtype=np.int32)
-    arr.node_max_pods = np.zeros(N, dtype=np.int32)
-    arr.node_valid = np.zeros(N, dtype=bool)
-    n_nodes = len(nodes_list)
-    if n_nodes:
-        for col, attr in ((arr.node_idle, "idle"), (arr.node_used, "used")):
-            col[:n_nodes, 0] = np.fromiter(
-                (getattr(n, attr).milli_cpu for n in nodes_list), np.float32,
-                n_nodes)
-            col[:n_nodes, 1] = np.fromiter(
-                (getattr(n, attr).memory for n in nodes_list), np.float32,
-                n_nodes)
-        arr.node_extra_future[:n_nodes, 0] = np.fromiter(
-            (n.releasing.milli_cpu - n.pipelined.milli_cpu
-             for n in nodes_list), np.float32, n_nodes)
-        arr.node_extra_future[:n_nodes, 1] = np.fromiter(
-            (n.releasing.memory - n.pipelined.memory for n in nodes_list),
-            np.float32, n_nodes)
-        alloc_cpu = np.fromiter(
-            (n.allocatable.milli_cpu for n in nodes_list), np.float32, n_nodes)
-        alloc_mem = np.fromiter(
-            (n.allocatable.memory for n in nodes_list), np.float32, n_nodes)
-        arr.node_alloc[:n_nodes, 0] = np.where(alloc_cpu > 0, alloc_cpu, 1.0)
-        arr.node_alloc[:n_nodes, 1] = np.where(alloc_mem > 0, alloc_mem, 1.0)
-        arr.node_npods[:n_nodes] = np.fromiter(
-            (sum(1 for t in n.tasks.values()
-                 if t.status != TaskStatus.PIPELINED) for n in nodes_list),
-            np.int32, n_nodes)
-        arr.node_max_pods[:n_nodes] = np.fromiter(
-            (n.allocatable.max_task_num or 1 << 30 for n in nodes_list),
-            np.int32, n_nodes)
-        arr.node_valid[:n_nodes] = True
-        if len(vocab) > 2:
-            for i, ni in enumerate(nodes_list):
-                for res, col in ((ni.idle, arr.node_idle),
-                                 (ni.used, arr.node_used)):
-                    for name, v in res.scalars.items():
-                        idx = vocab.index(name)
-                        if idx is not None:
-                            col[i, idx] = v
-                for name, v in ni.allocatable.scalars.items():
-                    idx = vocab.index(name)
-                    if idx is not None and v > 0:
-                        arr.node_alloc[i, idx] = v
-                for name, v in ni.releasing.scalars.items():
-                    idx = vocab.index(name)
-                    if idx is not None:
-                        arr.node_extra_future[i, idx] += v
-                for name, v in ni.pipelined.scalars.items():
-                    idx = vocab.index(name)
-                    if idx is not None:
-                        arr.node_extra_future[i, idx] -= v
+    cache._task_key = task_wkey
+    cache._task_buf = (arr.task_init_req, arr.task_req, arr.task_job,
+                       arr.task_rank, arr.task_sig, arr.task_counts_ready,
+                       arr.task_valid, arr.job_min, arr.job_ready_base,
+                       arr.job_queue, arr.job_valid, sigs, sig_tasks,
+                       queue_index, queue_names)
+    return _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
+                   queue_index, queue_names, queues)
 
+
+def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
+            queue_index, queue_names, queues):
+    vocab = arr.vocab
+    # -- node side: persistent buffer, rewrite only changed rows ------------
+    node_key = tuple((ni.name, ni.flat_version) for ni in nodes_list)
+    buf = cache._node_buf
+    reusable = (buf is not None and buf["R"] == R and buf["N"] == N
+                and len(cache._node_key) == n_nodes)
+    if not reusable:
+        buf = {
+            "R": R, "N": N,
+            "idle": np.zeros((N, R), dtype=np.float32),
+            "extra": np.zeros((N, R), dtype=np.float32),
+            "used": np.zeros((N, R), dtype=np.float32),
+            "alloc": np.ones((N, R), dtype=np.float32),  # pads: avoid div 0
+            "npods": np.zeros(N, dtype=np.int32),
+            "maxp": np.zeros(N, dtype=np.int32),
+            "valid": np.zeros(N, dtype=bool),
+        }
+        buf["valid"][:n_nodes] = True
+        old_key = ()
+    else:
+        old_key = cache._node_key
+    for i, ni in enumerate(nodes_list):
+        if i < len(old_key) and old_key[i] == node_key[i] and reusable:
+            continue
+        row = cache.node_row(ni)
+        buf["idle"][i] = row["idle"]
+        buf["extra"][i] = row["extra"]
+        buf["used"][i] = row["used"]
+        buf["alloc"][i] = row["alloc"]
+        buf["npods"][i] = row["npods"]
+        buf["maxp"][i] = row["maxp"]
+    cache._node_key = node_key
+    cache._node_buf = buf
+    arr.node_idle = buf["idle"]
+    arr.node_extra_future = buf["extra"]
+    arr.node_used = buf["used"]
+    arr.node_alloc = buf["alloc"]
+    arr.node_npods = buf["npods"]
+    arr.node_max_pods = buf["maxp"]
+    arr.node_valid = buf["valid"]
+
+    # -- predicate signature masks (cached per signature x node epoch) ------
     S = max(len(sigs), 1)
     arr.sig_masks = np.zeros((S, N), dtype=bool)
     if not sig_tasks:
         arr.sig_masks[:, :] = True
-    for s_idx, t in enumerate(sig_tasks):
-        pod = t.pod
+    # label/taint-only masks survive resource-accounting churn: they key on
+    # spec versions; only port-aware masks key on the full node epoch
+    spec_key = tuple((ni.name, ni.spec_version) for ni in nodes_list)
+    for s, s_idx in sigs.items():
+        # (even the unconstrained "" signature must run the node loop:
+        # untolerated NoSchedule taints block constraint-free pods too)
+        row_key = node_key if sig_tasks[s_idx].pod.ports() else spec_key
+        cached = cache.sig_rows.get(s)
+        if cached is not None and cached[0] == row_key \
+                and cached[1].shape[0] == N:
+            arr.sig_masks[s_idx] = cached[1]
+            continue
+        pod = sig_tasks[s_idx].pod
+        row = np.zeros(N, dtype=bool)
         for n_idx, ni in enumerate(nodes_list):
             node = ni.node
             ok = True
@@ -429,7 +588,9 @@ def flatten_snapshot(
                     for other in ni.tasks.values():
                         taken.update(other.pod.ports())
                     ok = not (set(pod.ports()) & taken)
-            arr.sig_masks[s_idx, n_idx] = ok
+            row[n_idx] = ok
+        cache.sig_rows[s] = (row_key, row)
+        arr.sig_masks[s_idx] = row
 
     # queues (water-filling inputs; filled further by proportion plugin)
     Q = bucket(max(len(queue_names), 1))
@@ -452,4 +613,7 @@ def flatten_snapshot(
     arr.thresholds = vocab.thresholds()
     arr.scalar_dim_mask = np.zeros(R, dtype=bool)
     arr.scalar_dim_mask[2:] = True
+
+    cache.sweep({j.uid for j in arr.jobs_list},
+                {ni.name for ni in nodes_list}, sigs)
     return arr

@@ -281,7 +281,13 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             return (idle, pipe, npods, assigned, kind, excluded,
                     rounds + 1, jnp.any(got))
 
-        out = jax.lax.while_loop(cond, body, st + (jnp.bool_(True),))
+        # skip the phase outright when no task is still eligible (e.g. the
+        # pipeline phase after everything allocated): one [T] reduction
+        # instead of a full wasted [T,N] round
+        _, _, _, assigned0, _, excluded0, _ = st
+        any_eligible = jnp.any(a["task_valid"] & (assigned0 < 0)
+                               & ~excluded0[a["task_job"]])
+        out = jax.lax.while_loop(cond, body, st + (any_eligible,))
         return out[:-1]
 
     def gang_body(s):

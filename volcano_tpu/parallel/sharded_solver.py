@@ -25,28 +25,28 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map as _shard_map
+    _REP_KWARG = "check_vma"
+except ImportError:  # older jax: experimental API, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _REP_KWARG = "check_rep"
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.solver import (
-    NEG, BIG_KEY, SolveResult, _segment_prefix, score_matrix,
+    NEG, BIG_KEY, SolveResult, _segment_prefix, fits_matrix, score_matrix,
 )
+
+
+def shard_map(*args, **kwargs):
+    """shard_map with replication checking off, spelled for either jax API."""
+    kwargs[_REP_KWARG] = False
+    return _shard_map(*args, **kwargs)
 
 
 def make_mesh(devices=None, axis: str = "n") -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.array(devices), (axis,))
-
-
-def _fits_local(req, avail, thr, scalar_mask):
-    lhs = req[:, None, :]
-    rhs = avail[None, :, :] + thr[None, None, :]
-    dim_ok = lhs < rhs
-    ignored = scalar_mask[None, None, :] & (lhs <= 10.0)
-    return jnp.all(dim_ok | ignored, axis=-1)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "max_rounds",
@@ -70,7 +70,6 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
     rank = a["task_rank"]
 
-    node_sharded = P(None, ) if False else P("n")
     in_specs = {
         "task_init_req": P(), "task_req": P(), "task_job": P(),
         "task_rank": P(), "task_sig": P(), "task_counts_ready": P(),
@@ -95,7 +94,7 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
             with the waterfall herd spread computed on gathered [N]
             vectors."""
             pods_ok = (npods < a["node_max_pods"])[None, :]
-            feas = (_fits_local(a["task_init_req"], avail, thr, scalar_mask)
+            feas = (fits_matrix(a["task_init_req"], avail, thr, scalar_mask)
                     & sig_feas & pods_ok & eligible[:, None])
             used_now = a["node_used"] + (a["node_idle"] - idle)
             score = score_matrix(a["task_init_req"], avail, used_now,
@@ -269,8 +268,7 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
     mapped = shard_map(
         kernel, mesh=mesh,
         in_specs=(in_specs, params_spec),
-        out_specs=(P(), P(), P(), P()),
-        check_vma=False)
+        out_specs=(P(), P(), P(), P()))
     assigned, kind, job_ready, rounds = mapped(dict(a), dict(score_params))
     return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
                        rounds=rounds)
