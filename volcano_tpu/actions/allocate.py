@@ -280,6 +280,8 @@ class AllocateAction(Action):
         for conf in ssn.configurations:
             if conf.name == self.name():
                 mode = Arguments(conf.arguments).get("mode", "solver")
+        if ssn.solver_options.get("force_host_allocate"):
+            mode = "host"  # e.g. GPU sharing: card state is host-only
         if mode == "host":
             self._execute_host(ssn)
         elif mode == "sequential":

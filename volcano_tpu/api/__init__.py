@@ -1,6 +1,11 @@
 """Scheduler data model (reference pkg/scheduler/api)."""
 
 from .cluster_info import ClusterInfo  # noqa: F401
+from .device_info import (  # noqa: F401
+    GPU_INDEX, GPUDevice, PREDICATE_TIME, VOLCANO_GPU_NUMBER,
+    VOLCANO_GPU_RESOURCE, add_gpu_index, get_gpu_index, gpu_resource_of_pod,
+    predicate_gpu, remove_gpu_index,
+)
 from .job_info import (  # noqa: F401
     JobInfo, TaskInfo, job_key_of_pod, pod_key,
     get_pod_resource_request, get_pod_resource_without_init_containers,

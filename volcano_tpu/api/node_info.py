@@ -7,8 +7,13 @@ from each session.
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional
 
+from .device_info import (
+    GPUDevice, VOLCANO_GPU_NUMBER, VOLCANO_GPU_RESOURCE, get_gpu_index,
+    gpu_resource_of_pod,
+)
 from .job_info import TaskInfo
 from .resource import Resource
 from .types import NodePhase, TaskStatus
@@ -20,6 +25,11 @@ class NodeState:
     def __init__(self, phase: NodePhase = NodePhase.READY, reason: str = ""):
         self.phase = phase
         self.reason = reason
+
+
+#: distinguishes a deleted-and-recreated node (fresh version counters) from
+#: its predecessor in the flatten cache keys
+_EPOCH_COUNTER = itertools.count(1)
 
 
 class NodeInfo:
@@ -37,12 +47,15 @@ class NodeInfo:
         self.capability = Resource()
         self.tasks: Dict[str, TaskInfo] = {}
         self.others: Dict[str, object] = {}
+        # GPU sharing: card id -> GPUDevice (node_info.go:148-170)
+        self.gpu_devices: Dict[int, GPUDevice] = {}
         # bumped on any accounting mutation; the snapshot flattener's
         # per-node row cache keys on it (ops.arrays)
         self.flat_version = 0
         # bumped only when the node spec changes (set_node): label/taint
         # predicate masks key on this, so binds don't invalidate them
         self.spec_version = 0
+        self.flat_epoch = next(_EPOCH_COUNTER)
         if node is not None:
             self.set_node(node)
 
@@ -78,7 +91,9 @@ class NodeInfo:
         self.pipelined = Resource()
         self.idle = Resource.from_resource_list(node.allocatable)
         self.used = Resource()
+        self._set_gpu_info(node)
         for ti in self.tasks.values():
+            self.add_gpu_resource(ti.pod)
             if ti.status == TaskStatus.RELEASING:
                 self.idle.sub(ti.resreq)
                 self.releasing.add(ti.resreq)
@@ -96,6 +111,41 @@ class NodeInfo:
     def future_idle(self) -> Resource:
         """idle + releasing - pipelined (node_info.go:57-59)."""
         return self.idle.clone().add(self.releasing).sub(self.pipelined)
+
+    # -- GPU sharing (node_info.go:148-170, 342-391) ------------------------
+
+    def _set_gpu_info(self, node) -> None:
+        """Per-card devices from capacity volcano.sh/gpu-{memory,number}."""
+        self.gpu_devices = {}
+        cap = node.capacity or {}
+        total = cap.get(VOLCANO_GPU_RESOURCE)
+        count = cap.get(VOLCANO_GPU_NUMBER)
+        if not total or not count:
+            return
+        total, count = int(float(total)), int(float(count))
+        if count <= 0:
+            return
+        per_card = total // count
+        for i in range(count):
+            self.gpu_devices[i] = GPUDevice(i, per_card)
+
+    def devices_idle_gpu_memory(self) -> Dict[int, int]:
+        return {dev_id: dev.memory - dev.used_memory()
+                for dev_id, dev in self.gpu_devices.items()}
+
+    def add_gpu_resource(self, pod) -> None:
+        if gpu_resource_of_pod(pod) <= 0:
+            return
+        dev = self.gpu_devices.get(get_gpu_index(pod))
+        if dev is not None:
+            dev.pod_map[pod.uid] = pod
+
+    def sub_gpu_resource(self, pod) -> None:
+        if gpu_resource_of_pod(pod) <= 0:
+            return
+        dev = self.gpu_devices.get(get_gpu_index(pod))
+        if dev is not None:
+            dev.pod_map.pop(pod.uid, None)
 
     # -- task accounting ----------------------------------------------------
 
@@ -127,6 +177,7 @@ class NodeInfo:
         task.node_name = self.name
         ti.node_name = self.name
         self.tasks[ti.key] = ti
+        self.add_gpu_resource(ti.pod)
 
     def remove_task(self, ti: TaskInfo) -> None:
         task = self.tasks.get(ti.key)
@@ -144,6 +195,7 @@ class NodeInfo:
                 self.idle.add(task.resreq)
                 self.used.sub(task.resreq)
         del self.tasks[task.key]
+        self.sub_gpu_resource(task.pod)
 
     def update_task(self, ti: TaskInfo) -> None:
         self.remove_task(ti)
@@ -161,10 +213,12 @@ class NodeInfo:
         n.allocatable = self.allocatable.clone()
         n.capability = self.capability.clone()
         n.others = dict(self.others)
+        n.gpu_devices = {i: d.clone() for i, d in self.gpu_devices.items()}
         for k, t in self.tasks.items():
             n.tasks[k] = t.clone()
         n.flat_version = self.flat_version
         n.spec_version = self.spec_version
+        n.flat_epoch = self.flat_epoch
         return n
 
     def pods(self):

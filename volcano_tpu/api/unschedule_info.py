@@ -13,6 +13,7 @@ NODE_AFFINITY_FAILED = "node(s) didn't match node selector"
 TAINT_FAILED = "node(s) had taints that the pod didn't tolerate"
 POD_AFFINITY_FAILED = "node(s) didn't match pod affinity/anti-affinity"
 NODE_PORTS_FAILED = "node(s) didn't have free ports for the requested pod ports"
+GPU_SHARING_FAILED = "no enough gpu memory on single device"
 POD_COUNT_FAILED = "node(s) had too many pods"
 
 

@@ -287,7 +287,8 @@ class FlattenCache:
         vocab = self.vocab
         R = len(vocab)
         ent = self.node_rows.get(ni.name)
-        if ent is not None and ent["v"] == ni.flat_version and ent["R"] == R:
+        if ent is not None and ent["v"] == ni.flat_version \
+                and ent["e"] == ni.flat_epoch and ent["R"] == R:
             return ent
         idle = ni.idle.to_vector(vocab)
         used = ni.used.to_vector(vocab)
@@ -296,7 +297,8 @@ class FlattenCache:
         alloc = np.where(alloc > 0, alloc, 1.0).astype(np.float32)
         npods = sum(1 for t in ni.tasks.values()
                     if t.status != TaskStatus.PIPELINED)
-        ent = {"v": ni.flat_version, "R": R, "idle": idle, "used": used,
+        ent = {"v": ni.flat_version, "e": ni.flat_epoch, "R": R,
+               "idle": idle, "used": used,
                "extra": extra, "alloc": alloc, "npods": npods,
                "maxp": ni.allocatable.max_task_num or 1 << 30}
         self.node_rows[ni.name] = ent
@@ -518,7 +520,8 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
             queue_index, queue_names, queues):
     vocab = arr.vocab
     # -- node side: persistent buffer, rewrite only changed rows ------------
-    node_key = tuple((ni.name, ni.flat_version) for ni in nodes_list)
+    node_key = tuple((ni.name, ni.flat_epoch, ni.flat_version)
+                     for ni in nodes_list)
     buf = cache._node_buf
     reusable = (buf is not None and buf["R"] == R and buf["N"] == N
                 and len(cache._node_key) == n_nodes)
@@ -564,7 +567,8 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
         arr.sig_masks[:, :] = True
     # label/taint-only masks survive resource-accounting churn: they key on
     # spec versions; only port-aware masks key on the full node epoch
-    spec_key = tuple((ni.name, ni.spec_version) for ni in nodes_list)
+    spec_key = tuple((ni.name, ni.flat_epoch, ni.spec_version)
+                     for ni in nodes_list)
     for s, s_idx in sigs.items():
         # (even the unconstrained "" signature must run the node loop:
         # untolerated NoSchedule taints block constraint-free pods too)

@@ -14,11 +14,16 @@ InterPodAffinity. Two forms:
 from __future__ import annotations
 
 from ..api import FitError
+from ..api.device_info import (
+    add_gpu_index, get_gpu_index, gpu_resource_of_pod, predicate_gpu,
+    remove_gpu_index,
+)
 from ..api.unschedule_info import (
-    NODE_AFFINITY_FAILED, NODE_PORTS_FAILED, NODE_UNSCHEDULABLE,
-    POD_AFFINITY_FAILED, POD_COUNT_FAILED, TAINT_FAILED,
+    GPU_SHARING_FAILED, NODE_AFFINITY_FAILED, NODE_PORTS_FAILED,
+    NODE_UNSCHEDULABLE, POD_AFFINITY_FAILED, POD_COUNT_FAILED, TAINT_FAILED,
 )
 from ..framework import Plugin
+from ..framework.event import EventHandler
 from ..ops.arrays import (
     _match_node_selector, _node_affinity_match, _tolerates,
 )
@@ -51,12 +56,58 @@ def _pod_affinity_ok(pod, node, tasks_on_node) -> bool:
 class PredicatesPlugin(Plugin):
     def __init__(self, arguments=None):
         self.arguments = arguments or {}
+        # predicate.GPUSharingEnable (predicates.go:100-133)
+        get = getattr(self.arguments, "get_bool", None)
+        if get is not None:
+            self.gpu_sharing = get("predicate.GPUSharingEnable", False)
+        else:
+            self.gpu_sharing = bool(
+                (self.arguments or {}).get("predicate.GPUSharingEnable"))
 
     def name(self) -> str:
         return "predicates"
 
     def on_session_open(self, ssn) -> None:
         ssn.solver_options["predicates"] = True
+        if self.gpu_sharing:
+            # per-card feasibility depends on in-flight card assignments, so
+            # the allocate pass must run the sequential host loop
+            ssn.solver_options["force_host_allocate"] = True
+
+            def on_allocate(event):
+                """Pick a card, annotate the pod, join its pod_map
+                (predicates.go:117-133 AllocateFunc)."""
+                task = event.task
+                pod = task.pod
+                if gpu_resource_of_pod(pod) <= 0:
+                    return
+                node_info = ssn.nodes.get(task.node_name)
+                if node_info is None:
+                    return
+                dev_id = predicate_gpu(pod, node_info)
+                if dev_id < 0:
+                    return
+                add_gpu_index(pod, dev_id)
+                dev = node_info.gpu_devices.get(dev_id)
+                if dev is not None:
+                    dev.pod_map[pod.uid] = pod
+
+            def on_deallocate(event):
+                """Free the card on statement undo / eviction
+                (predicates.go:145-160 DeallocateFunc)."""
+                task = event.task
+                pod = task.pod
+                if gpu_resource_of_pod(pod) <= 0:
+                    return
+                node_info = ssn.nodes.get(task.node_name)
+                if node_info is not None:
+                    dev = node_info.gpu_devices.get(get_gpu_index(pod))
+                    if dev is not None:
+                        dev.pod_map.pop(pod.uid, None)
+                remove_gpu_index(pod)
+
+            ssn.add_event_handler(EventHandler(
+                allocate_func=on_allocate, deallocate_func=on_deallocate))
 
         def predicate_fn(task, node_info):
             node = node_info.node
@@ -82,6 +133,10 @@ class PredicatesPlugin(Plugin):
                 if pod.affinity and not _pod_affinity_ok(
                         pod, node, list(node_info.tasks.values())):
                     reasons.append(POD_AFFINITY_FAILED)
+                if self.gpu_sharing and gpu_resource_of_pod(pod) > 0 \
+                        and predicate_gpu(pod, node_info) < 0:
+                    # no single card has enough idle memory (gpu.go:27-55)
+                    reasons.append(GPU_SHARING_FAILED)
             if reasons:
                 raise PredicateError(FitError(task, node_info.name, reasons))
 
