@@ -1,0 +1,148 @@
+"""Leader election, metrics endpoint, and assert util tests
+(reference cmd/*/app/server.go leader election; metrics.go; assert.go)."""
+
+import threading
+import urllib.request
+
+import pytest
+
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.utils import (
+    AssertionFailed, LeaderElector, LeaseLock, assert_, assertf,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestLeaderElection:
+    def _elector(self, store, name, clock, log):
+        return LeaderElector(
+            LeaseLock(store, "volcano"), identity=name, clock=clock,
+            on_started_leading=lambda: log.append(f"{name}+"),
+            on_stopped_leading=lambda: log.append(f"{name}-"))
+
+    def test_single_leader_at_a_time(self):
+        store, clock, log = ClusterStore(), FakeClock(), []
+        a = self._elector(store, "a", clock, log)
+        b = self._elector(store, "b", clock, log)
+        assert a.step() is True
+        assert b.step() is False
+        assert log == ["a+"]
+        # a keeps renewing: b stays standby
+        for _ in range(5):
+            clock.t += 5
+            a.step()
+            assert b.step() is False
+        assert a.is_leader and not b.is_leader
+
+    def test_failover_on_lease_expiry(self):
+        store, clock, log = ClusterStore(), FakeClock(), []
+        a = self._elector(store, "a", clock, log)
+        b = self._elector(store, "b", clock, log)
+        a.step()
+        # a dies; after lease_duration b takes over
+        clock.t += a.lease_duration + 1
+        assert b.step() is True
+        assert "b+" in log
+        lease = store.get("leases", "volcano")
+        assert lease.holder_identity == "b"
+        assert lease.lease_transitions == 2
+
+    def test_release_hands_over_immediately(self):
+        store, clock, log = ClusterStore(), FakeClock(), []
+        a = self._elector(store, "a", clock, log)
+        b = self._elector(store, "b", clock, log)
+        a.step()
+        a.release()
+        assert log == ["a+", "a-"]
+        assert b.step() is True
+
+    def test_deposed_leader_steps_down(self):
+        store, clock, log = ClusterStore(), FakeClock(), []
+        a = self._elector(store, "a", clock, log)
+        b = self._elector(store, "b", clock, log)
+        a.step()
+        clock.t += a.lease_duration + 1
+        b.step()  # took over while a was wedged
+        assert a.step() is False
+        assert log == ["a+", "b+", "a-"]
+
+
+class TestMetricsServer:
+    def test_serves_metrics_healthz_stacks(self):
+        from volcano_tpu.metrics import MetricsServer, metrics
+
+        srv = MetricsServer(port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            metrics.schedule_attempts.inc(labels={"result": "scheduled"})
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "volcano_schedule_attempts_total" in body
+            assert "volcano_e2e_scheduling_latency_milliseconds" in body
+            assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
+            stacks = urllib.request.urlopen(
+                f"{base}/debug/stacks").read().decode()
+            assert "thread" in stacks
+            with pytest.raises(Exception):
+                urllib.request.urlopen(f"{base}/nope")
+        finally:
+            srv.stop()
+
+
+class TestAssertUtil:
+    def test_raises_by_default(self):
+        assert_(True, "fine")
+        with pytest.raises(AssertionFailed, match="boom"):
+            assert_(False, "boom")
+        with pytest.raises(AssertionFailed, match="x=3"):
+            assertf(False, "x=%d", 3)
+
+
+class TestSchedulerHA:
+    def test_standby_does_not_schedule_until_leader_dies(self):
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.cache.fakes import FakeBinder
+        from volcano_tpu.scheduler import Scheduler
+        from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
+        from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+
+        store = ClusterStore()
+        cache = SchedulerCache(store)
+        binder = FakeBinder()
+        cache.binder = binder
+        cache.add_node(Node(name="n1",
+                            allocatable={"cpu": "4", "memory": "8Gi"},
+                            capacity={"cpu": "4", "memory": "8Gi"}))
+        cache.set_pod_group(PodGroup(name="pg", namespace="d",
+                                     spec=PodGroupSpec(min_member=1)))
+        cache.add_pod(Pod(name="p", namespace="d",
+                          annotations={POD_GROUP_ANNOTATION: "pg"},
+                          containers=[{"requests": {"cpu": "1"}}]))
+
+        # another process already holds the lease: scheduler must idle
+        other = LeaderElector(LeaseLock(store, "volcano"), identity="other")
+        other.step()
+
+        sched = Scheduler(cache)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=sched.run_with_leader_election, args=(stop,), daemon=True)
+        sched.period = 0.01
+        t.start()
+        import time
+        time.sleep(0.3)
+        assert binder.binds == {}  # standby never scheduled
+
+        other.release()  # leader exits cleanly -> takeover
+        deadline = time.time() + 10
+        while not binder.binds and time.time() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5)
+        assert binder.binds == {"d/p": "n1"}

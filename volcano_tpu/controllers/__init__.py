@@ -41,3 +41,31 @@ class ControllerManager:
         for _ in range(rounds):
             for ctrl in self.controllers:
                 ctrl.process_all()
+
+    def run_with_leader_election(self, stop, lock_name: str = "vc-controller-manager",
+                                 identity: str = None) -> None:
+        """HA mode (cmd/controller-manager/app/server.go:98-127): only the
+        lease holder runs the controllers; a standby takes over when the
+        leader's lease expires. Renewal runs on its own thread at the retry
+        period; controllers subscribe their watches only once even if
+        leadership is lost and regained."""
+        import threading
+        from ..utils import LeaderElector, LeaseLock
+
+        elector = LeaderElector(
+            LeaseLock(self.opt.cluster, lock_name), identity=identity)
+        self._elector = elector
+        renewer = threading.Thread(target=elector.run, args=(stop,),
+                                   name="leader-elector", daemon=True)
+        renewer.start()
+        subscribed = False
+        while not stop.is_set():
+            if elector.is_leader:
+                if not subscribed:
+                    self.run()
+                    subscribed = True
+                self.process_all(rounds=1)
+                stop.wait(0.05)
+            else:
+                stop.wait(0.05)
+        renewer.join(timeout=2 * elector.retry_period)

@@ -2,3 +2,4 @@
 
 from . import metrics  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, Registry, registry  # noqa: F401
+from .server import DEFAULT_LISTEN_PORT, MetricsServer  # noqa: F401

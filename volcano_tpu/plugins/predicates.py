@@ -55,14 +55,13 @@ def _pod_affinity_ok(pod, node, tasks_on_node) -> bool:
 
 class PredicatesPlugin(Plugin):
     def __init__(self, arguments=None):
+        from ..framework import Arguments
+
         self.arguments = arguments or {}
         # predicate.GPUSharingEnable (predicates.go:100-133)
-        get = getattr(self.arguments, "get_bool", None)
-        if get is not None:
-            self.gpu_sharing = get("predicate.GPUSharingEnable", False)
-        else:
-            self.gpu_sharing = bool(
-                (self.arguments or {}).get("predicate.GPUSharingEnable"))
+        args = (self.arguments if isinstance(self.arguments, Arguments)
+                else Arguments(self.arguments))
+        self.gpu_sharing = args.get_bool("predicate.GPUSharingEnable", False)
 
     def name(self) -> str:
         return "predicates"
@@ -73,6 +72,9 @@ class PredicatesPlugin(Plugin):
             # per-card feasibility depends on in-flight card assignments, so
             # the allocate pass must run the sequential host loop
             ssn.solver_options["force_host_allocate"] = True
+            # evict-then-discard undo must restore the card the pod actually
+            # occupies, not re-run first-fit: uid -> (node_name, card id)
+            released_cards = {}
 
             def on_allocate(event):
                 """Pick a card, annotate the pod, join its pod_map
@@ -84,7 +86,11 @@ class PredicatesPlugin(Plugin):
                 node_info = ssn.nodes.get(task.node_name)
                 if node_info is None:
                     return
-                dev_id = predicate_gpu(pod, node_info)
+                restored = released_cards.pop(pod.uid, None)
+                if restored is not None and restored[0] == task.node_name:
+                    dev_id = restored[1]
+                else:
+                    dev_id = predicate_gpu(pod, node_info)
                 if dev_id < 0:
                     return
                 add_gpu_index(pod, dev_id)
@@ -100,8 +106,11 @@ class PredicatesPlugin(Plugin):
                 if gpu_resource_of_pod(pod) <= 0:
                     return
                 node_info = ssn.nodes.get(task.node_name)
+                dev_id = get_gpu_index(pod)
                 if node_info is not None:
-                    dev = node_info.gpu_devices.get(get_gpu_index(pod))
+                    if dev_id >= 0:
+                        released_cards[pod.uid] = (task.node_name, dev_id)
+                    dev = node_info.gpu_devices.get(dev_id)
                     if dev is not None:
                         dev.pod_map.pop(pod.uid, None)
                 remove_gpu_index(pod)

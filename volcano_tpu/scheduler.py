@@ -79,6 +79,41 @@ class Scheduler:
         metrics.e2e_scheduling_latency.observe(
             (time.perf_counter() - t0) * 1e3)
 
+    def run_with_leader_election(self, stop, lock_name: str = "volcano",
+                                 identity: Optional[str] = None) -> None:
+        """HA mode (cmd/scheduler/app/server.go:85-145): only the lease
+        holder schedules; standbys poll the lease and take over on expiry.
+
+        Lease renewal runs on its own thread at the elector's retry period
+        (like client-go's renew loop), so a long scheduling cycle or a long
+        schedule-period can't blow the renew deadline."""
+        import threading
+
+        from .utils import LeaderElector, LeaseLock
+
+        elector = LeaderElector(
+            LeaseLock(self.cache.cluster, lock_name), identity=identity)
+        self._elector = elector
+        renewer = threading.Thread(target=elector.run, args=(stop,),
+                                   name="leader-elector", daemon=True)
+        renewer.start()
+        synced = False
+        while not stop.is_set():
+            if elector.is_leader:
+                if not synced:
+                    self.cache.run()
+                    self.cache.wait_for_cache_sync()
+                    synced = True
+                self.cache.process_resync_tasks()
+                try:
+                    self.run_once()
+                except Exception:
+                    log.exception("scheduling cycle failed")
+                stop.wait(self.period)
+            else:
+                stop.wait(0.05)
+        renewer.join(timeout=2 * elector.retry_period)
+
     def run(self, stop_after: Optional[int] = None) -> None:
         """Run the periodic loop; stop_after bounds cycles for tests."""
         self.cache.run()

@@ -1,5 +1,9 @@
 """Scheduler utilities (reference pkg/scheduler/util)."""
 
+from .assert_util import AssertionFailed, assert_, assertf  # noqa: F401
+from .leader_election import (  # noqa: F401
+    LeaderElector, Lease, LeaseLock,
+)
 from .priority_queue import PriorityQueue  # noqa: F401
 from .scheduler_helper import (  # noqa: F401
     ResourceReservation, reservation, validate_victims,

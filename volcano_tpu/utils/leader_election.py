@@ -1,0 +1,145 @@
+"""Leader election on a Lease object (reference cmd/scheduler/app/server.go:
+85-145, cmd/controller-manager/app/server.go:98-127).
+
+The reference elects via a client-go resourcelock against the API server;
+here the lock is a Lease record in the ClusterStore (the build's API-server
+seam), with the same lease-duration/renew-deadline/retry-period contract and
+the same observable behavior: exactly one elector runs its callback at a
+time, a crashed leader's lease expires and a standby takes over.
+
+``step()`` drives one acquire-or-renew attempt with an injectable clock so
+tests are deterministic; ``run()`` is the wall-clock loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+LEASE_DURATION = 15.0   # server.go:50
+RENEW_DEADLINE = 10.0   # server.go:51
+RETRY_PERIOD = 5.0      # server.go:52
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease subset (cluster-scoped here)."""
+
+    name: str
+    holder_identity: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_seconds: float = LEASE_DURATION
+    lease_transitions: int = 0
+    resource_version: int = 0
+    uid: str = field(default_factory=lambda: str(uuid.uuid4()))
+
+
+class LeaseLock:
+    """Get/create/update a named Lease in the cluster store."""
+
+    def __init__(self, store, name: str):
+        self.store = store
+        self.name = name
+
+    def get(self) -> Optional[Lease]:
+        try:
+            return self.store.get("leases", self.name)
+        except Exception:
+            return None
+
+    def create_or_update(self, lease: Lease) -> Lease:
+        return self.store.apply("leases", lease)
+
+
+class LeaderElector:
+    """Acquire the lease, keep renewing, report leadership changes."""
+
+    def __init__(self, lock: LeaseLock, identity: Optional[str] = None,
+                 lease_duration: float = LEASE_DURATION,
+                 renew_deadline: float = RENEW_DEADLINE,
+                 retry_period: float = RETRY_PERIOD,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 clock: Callable[[], float] = time.time):
+        # hostname_uuid uniquifier (server.go:108-110)
+        self.identity = identity or f"{uuid.uuid4().hex[:8]}_{uuid.uuid4()}"
+        self.lock = lock
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.clock = clock
+        self.is_leader = False
+        self._last_renew = 0.0
+
+    # -- one protocol step (testable) ---------------------------------------
+
+    def step(self) -> bool:
+        """Try to acquire or renew; returns current leadership."""
+        now = self.clock()
+        lease = self.lock.get()
+        if (self.is_leader and lease is not None
+                and lease.holder_identity == self.identity
+                and now - self._last_renew < self.retry_period):
+            # freshly renewed: don't re-write the lease on every call
+            return True
+        held_by_other = (
+            lease is not None and lease.holder_identity
+            and lease.holder_identity != self.identity
+            and now < lease.renew_time + lease.lease_duration_seconds)
+        if held_by_other:
+            if self.is_leader:
+                self._lose()
+            return False
+
+        if self.is_leader and now - self._last_renew > self.renew_deadline:
+            # failed to renew within the deadline: step down (the lease may
+            # already have been taken over)
+            self._lose()
+            return False
+
+        new = lease or Lease(name=self.lock.name)
+        if new.holder_identity != self.identity:
+            new.lease_transitions += 1
+            new.acquire_time = now
+        new.holder_identity = self.identity
+        new.renew_time = now
+        new.lease_duration_seconds = self.lease_duration
+        try:
+            self.lock.create_or_update(new)
+        except Exception:
+            return self.is_leader
+        self._last_renew = now
+        if not self.is_leader:
+            self.is_leader = True
+            if self.on_started_leading is not None:
+                self.on_started_leading()
+        return True
+
+    def _lose(self) -> None:
+        self.is_leader = False
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+
+    def release(self) -> None:
+        """Voluntarily give up the lease (clean shutdown)."""
+        lease = self.lock.get()
+        if lease is not None and lease.holder_identity == self.identity:
+            lease.renew_time = 0.0
+            lease.holder_identity = ""
+            self.lock.create_or_update(lease)
+        if self.is_leader:
+            self._lose()
+
+    # -- wall-clock loop ----------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            self.step()
+            stop.wait(self.retry_period)
+        self.release()
