@@ -128,7 +128,11 @@ class SchedulerCache:
                 "queues", Queue(name=self.default_queue, spec=QueueSpec(weight=1)))
 
     def run(self) -> None:
-        """Subscribe to the store's watch streams (informer start)."""
+        """Subscribe to the store's watch streams (informer start).
+        Idempotent: repeated Scheduler.run() calls must not double-subscribe
+        (the reference starts its informer factory once)."""
+        if self._synced:
+            return
         c = self.cluster
         c.watch("pods", self._on_pod)
         c.watch("nodes", self._on_node)
