@@ -165,7 +165,7 @@ class TestJobErrorHandling:
         w = World(nodes=1, node_cpu="2")
         w.store.create("jobs", make_job(replicas=4, cpu="1"))
         w.converge()
-        assert w.phase() in (JobPhase.PENDING, JobPhase.INQUEUE)
+        assert w.phase() == JobPhase.PENDING
         assert all(not p.node_name for p in w.pods("job1"))
         w.store.create("nodes", build_node("extra",
                                            {"cpu": "4", "memory": "8Gi"}))
@@ -183,14 +183,14 @@ class TestCommands:
 
         w.store.create("commands", Command(
             name="abort-job1", namespace="default", action=Action.ABORT_JOB,
-            target_object={"name": "job1"}))
+            target_object={"kind": "Job", "name": "job1"}))
         w.converge()
         assert w.phase() == JobPhase.ABORTED
         assert w.pods("job1") == []  # pods torn down
 
         w.store.create("commands", Command(
             name="resume-job1", namespace="default", action=Action.RESUME_JOB,
-            target_object={"name": "job1"}))
+            target_object={"kind": "Job", "name": "job1"}))
         w.converge()
         assert w.phase() == JobPhase.RUNNING
         assert len(w.pods("job1")) == 2

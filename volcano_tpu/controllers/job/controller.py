@@ -76,6 +76,12 @@ class JobController(Controller):
         # the `old` object of an update event may alias the new one; phase
         # transitions are detected against this map instead
         self._pod_phases: Dict[str, str] = {}
+        # last observed (spec fingerprint, phase) per job — status-only
+        # updates must NOT re-enqueue OutOfSync or terminal-state jobs would
+        # reconcile (and version-bump) forever
+        # (job_controller_handler.go:98-103: "we only reconcile job based on
+        # Spec ... ignored since no update in 'Spec'")
+        self._job_obs: Dict[str, tuple] = {}
 
     def name(self) -> str:
         return "job-controller"
@@ -126,14 +132,20 @@ class JobController(Controller):
     def _on_job(self, event, job: Job, old) -> None:
         if event == "add":
             self.cache.add(job)
+            self._job_obs[job.key] = (repr(job.spec), job.status.state.phase)
             self._enqueue(Request(job.namespace, job.name,
                                   event=Event.OUT_OF_SYNC))
         elif event == "update":
             self.cache.update(job)
+            obs = (repr(job.spec), job.status.state.phase)
+            if self._job_obs.get(job.key) == obs:
+                return
+            self._job_obs[job.key] = obs
             self._enqueue(Request(job.namespace, job.name,
                                   event=Event.OUT_OF_SYNC,
                                   job_version=job.status.version))
         else:
+            self._job_obs.pop(job.key, None)
             self.cache.delete(job)
             for name, args in (job.spec.plugins or {}).items():
                 plugin = get_plugin(name, args, self.cluster)
@@ -405,10 +417,12 @@ class JobController(Controller):
         ji2 = self.cache.get(job.key)
         before = self._status_tuple(job.status)
         self._update_counts(job.status, ji2.pods if ji2 else {})
-        phase_changed = bool(update_status_fn(job.status)) \
-            if update_status_fn else False
-        if phase_changed:
-            job.status.version += 1
+        # NOTE: sync never bumps status.version — the reference bumps only in
+        # killJob (job_controller_actions.go:92); bumping here version-gates
+        # first-generation pods' PodFailed requests to SyncJob and lifecycle
+        # policies (RestartJob/AbortJob/...) would never fire.
+        if update_status_fn:
+            update_status_fn(job.status)
         if self._status_tuple(job.status) != before \
                 or self.cluster.try_get("jobs", job.name, job.namespace) is None:
             self.cluster.apply("jobs", job)
@@ -430,12 +444,12 @@ class JobController(Controller):
                 except NotFoundError:
                     pass
         ji2 = self.cache.get(job.key)
-        before = self._status_tuple(job.status)
         self._update_counts(job.status, ji2.pods if ji2 else {})
         job.status.terminating = max(job.status.terminating, terminating)
-        phase_changed = bool(update_status_fn(job.status)) \
-            if update_status_fn else False
-        if phase_changed:
-            job.status.version += 1
-        if self._status_tuple(job.status) != before:
-            self.cluster.apply("jobs", job)
+        # "Job version is bumped only when job is killed" — unconditionally,
+        # whether or not the phase closure transitions
+        # (job_controller_actions.go:90-92).
+        job.status.version += 1
+        if update_status_fn:
+            update_status_fn(job.status)
+        self.cluster.apply("jobs", job)
