@@ -153,7 +153,7 @@ class TestVictimDispatch:
         victims = ssn.preemptable(tasks[0], tasks)
         assert [v.name for v in victims] == ["p1"]
 
-    def test_empty_tier_falls_through(self):
+    def test_empty_tier_result_poisons_later_tiers(self):
         class V(Plugin):
             def __init__(self, name, picks):
                 self._name, self._picks = name, picks
@@ -172,8 +172,40 @@ class TestVictimDispatch:
 
         register_plugin_builder("vnone", lambda a: V("vnone", set()))
         register_plugin_builder("vp2", lambda a: V("vp2", {"p2"}))
+        # an earlier tier whose fn RAN and returned nothing poisons later
+        # tiers: the intersection accumulator is never reset
+        # (session_plugins.go:121-160, `init` persists across tiers)
         tiers = [Tier(plugins=[PluginOption(name="vnone")]),
                  Tier(plugins=[PluginOption(name="vp2")])]
+        store, cache, ssn = make_session(tiers, pods=3, min_member=1)
+        tasks = list(ssn.jobs["ns1/pg1"].tasks.values())
+        victims = ssn.preemptable(tasks[0], tasks)
+        assert victims == []
+
+    def test_tier_without_fns_falls_through(self):
+        """A tier whose plugins register no victim fn makes no decision;
+        the next tier's answer stands."""
+        class V(Plugin):
+            def __init__(self, name, picks):
+                self._name, self._picks = name, picks
+
+            def name(self):
+                return self._name
+
+            def on_session_open(self, ssn):
+                if self._picks is not None:
+                    ssn.add_preemptable_fn(
+                        self._name,
+                        lambda preemptor, preemptees: [
+                            t for t in preemptees if t.name in self._picks])
+
+            def on_session_close(self, ssn):
+                pass
+
+        register_plugin_builder("vsilent", lambda a: V("vsilent", None))
+        register_plugin_builder("vp2b", lambda a: V("vp2b", {"p2"}))
+        tiers = [Tier(plugins=[PluginOption(name="vsilent")]),
+                 Tier(plugins=[PluginOption(name="vp2b")])]
         store, cache, ssn = make_session(tiers, pods=3, min_member=1)
         tasks = list(ssn.jobs["ns1/pg1"].tasks.values())
         victims = ssn.preemptable(tasks[0], tasks)

@@ -105,8 +105,42 @@ class TestPreempt:
 
 class TestReclaim:
     def test_cross_queue_reclaim(self):
-        """reclaim_test.go: q2's starving job reclaims from q1 which exceeds
-        its deserved share."""
+        """reclaim_test.go:44-177: q2's starving high-priority job reclaims
+        from q1's low-priority job. One tier [conformance, gang], victims
+        come from gang's priority comparison — reclaim across equal-priority
+        jobs yields no victims in this reference version (the dispatch's
+        intersection accumulator persists across tiers)."""
+        from volcano_tpu.models import PriorityClass
+        queues = [build_queue("q1", weight=1), build_queue("q2", weight=1)]
+        pg1 = build_pod_group("pg1", "c1", min_member=1, queue="q1")
+        pg1.spec.priority_class_name = "low-priority"
+        pg2 = build_pod_group("pg2", "c1", min_member=1, queue="q2")
+        pg2.spec.priority_class_name = "high-priority"
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "4", "memory": "4Gi"})],
+            [pg1, pg2],
+            [build_pod("c1", f"a{i}", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "pg1")
+             for i in range(4)]
+            + [build_pod("c1", "b0", "", "Pending",
+                         {"cpu": "1", "memory": "1Gi"}, "pg2")],
+            queues=queues,
+            priority_classes=[PriorityClass(name="high-priority", value=100),
+                              PriorityClass(name="low-priority", value=1)])
+        tiers = [Tier(plugins=[PluginOption(name="conformance"),
+                               PluginOption(name="gang")])]
+        ssn = open_session(cache, tiers)
+        get_action("reclaim").execute(ssn)
+        assert len(cache.evictor.evicts) == 1
+        assert cache.evictor.evicts[0].startswith("c1/a")
+        job2 = ssn.jobs["c1/pg2"]
+        assert job2.waiting_task_num() == 1
+        close_session(ssn)
+
+    def test_equal_priority_no_cross_queue_reclaim(self):
+        """With gang registered and equal job priorities, the victim
+        intersection is empty and stays empty through later tiers
+        (session_plugins.go:121-160 `init` persists across tiers)."""
         queues = [build_queue("q1", weight=1), build_queue("q2", weight=1)]
         pg1 = build_pod_group("pg1", "c1", min_member=1, queue="q1")
         pg2 = build_pod_group("pg2", "c1", min_member=1, queue="q2")
@@ -125,10 +159,7 @@ class TestReclaim:
                                PluginOption(name="predicates")])]
         ssn = open_session(cache, tiers)
         get_action("reclaim").execute(ssn)
-        assert len(cache.evictor.evicts) == 1
-        assert cache.evictor.evicts[0].startswith("c1/a")
-        job2 = ssn.jobs["c1/pg2"]
-        assert job2.waiting_task_num() == 1
+        assert cache.evictor.evicts == []
         close_session(ssn)
 
     def test_non_reclaimable_queue_protected(self):

@@ -41,7 +41,14 @@ class DefaultBinder:
 
 
 class DefaultEvictor:
-    """Sets PodReady=false then deletes the pod (cache.go:139-169)."""
+    """Sets PodReady=false then requests graceful deletion (cache.go:139-169).
+
+    Deletion is graceful, as in k8s: the pod gets a deletion_timestamp and
+    stays bound (task goes Releasing, so the freed space is FutureIdle, not
+    Idle) until the kubelet stand-in finalizes the termination and removes
+    the pod. Instant removal here would let the victim's replacement pod be
+    recreated and re-bound in the very next cycle, starving the
+    preemptor/reclaimer forever."""
 
     def __init__(self, cluster: ClusterStore):
         self.cluster = cluster
@@ -50,8 +57,9 @@ class DefaultEvictor:
         pod.conditions = [c for c in pod.conditions if c.get("type") != "Ready"]
         pod.conditions.append({"type": "Ready", "status": "False",
                                "reason": "Evict", "message": reason})
+        if pod.deletion_timestamp is None:
+            pod.deletion_timestamp = time.time()
         self.cluster.update("pods", pod)
-        self.cluster.delete("pods", pod.name, pod.namespace)
 
 
 class DefaultStatusUpdater:

@@ -186,11 +186,18 @@ class Session:
         return l < r
 
     def _victims_dispatch(self, registry: str, claimer, claimees):
-        """Per tier: intersect candidate lists across the tier's plugins; the
-        first tier whose intersection is non-empty decides (reference treats a
-        nil/empty tier result as no decision and falls through)."""
+        """Intersect candidate lists across plugins; return at the end of the
+        first tier whose running intersection is non-empty.
+
+        The intersection accumulator is NOT reset between tiers
+        (session_plugins.go:121-160: `init` persists) — once any fn returns
+        no victims, every later tier intersects against the empty set. In
+        practice this means e.g. reclaim only yields victims when the
+        first tier's gang fn (priority-based) approves them, which is why
+        the reference's positive reclaim e2e cases all use high-vs-low
+        priority classes."""
+        victims = None
         for _, group in _group_by_tier(self._tier_fns(registry)):
-            victims = None
             for _, _, fn in group:
                 candidates = fn(claimer, claimees)
                 if victims is None:

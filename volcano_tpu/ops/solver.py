@@ -56,12 +56,16 @@ class SolveResult(NamedTuple):
 def fits_matrix(req, avail, thr, scalar_mask):
     """LessEqual(req, avail) per (task, node): [T,N] bool.
 
-    req [T,R], avail [N,R]; a dim fits iff req < avail + thr; scalar dims
-    with req <= 10 are ignored entirely (resource_info.go LessEqual).
+    req [T,R], avail [N,R]; a dim fits iff req < avail + thr OR req <= avail;
+    scalar dims with req <= 10 are ignored entirely (resource_info.go
+    LessEqual).
     """
     lhs = req[:, None, :]                       # [T,1,R]
     rhs = avail[None, :, :] + thr[None, None, :]  # [1,N,R]
-    dim_ok = lhs < rhs
+    # the <= disjunct keeps exact fits feasible: at memory magnitudes the
+    # threshold vanishes in float32 (2^30 + 1 rounds to 2^30), so lhs < rhs
+    # alone would reject req == avail
+    dim_ok = (lhs < rhs) | (lhs <= avail[None, :, :])
     ignored = scalar_mask[None, None, :] & (lhs <= 10.0)
     return jnp.all(dim_ok | ignored, axis=-1)   # [T,N]
 
@@ -197,7 +201,8 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
     prefix = _segment_prefix(s_fit, seg_start)                     # [T,R]
 
     s_avail = avail[jnp.maximum(s_choice, 0)]                      # [T,R]
-    dim_ok = (prefix + s_fit) < (s_avail + thr[None, :])
+    lhs = prefix + s_fit
+    dim_ok = (lhs < (s_avail + thr[None, :])) | (lhs <= s_avail)
     ignored = scalar_mask[None, :] & (s_fit <= 10.0)
     fits = jnp.all(dim_ok | ignored, axis=-1) & s_active
     # pod-count prefix: position within segment
@@ -371,8 +376,9 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
     sig_feas_all = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
 
     def fits_one(req, avail):
-        dim_ok = req[None, :] < avail + thr[None, :]
-        ignored = scalar_mask[None, :] & (req[None, :] <= 10.0)
+        lhs = req[None, :]
+        dim_ok = (lhs < avail + thr[None, :]) | (lhs <= avail)
+        ignored = scalar_mask[None, :] & (lhs <= 10.0)
         return jnp.all(dim_ok | ignored, axis=-1)
 
     def finalize_job(carry, jidx):
