@@ -216,3 +216,87 @@ class TestHDRF:
         assert alloc["pg21"][0] == 5000
         assert alloc["pg22"][1] == 5e9
         close_session(ssn)
+
+
+class TestWaterFillKernel:
+    """On-device water_fill_deserved parity vs the host proportion plugin."""
+
+    def _kernel_deserved(self, total, weights, caps, requests):
+        import numpy as np
+        from volcano_tpu.ops.solver import water_fill_deserved
+        Q = len(weights)
+        R = len(total)
+        thr = np.array([10.0, 1.0] + [10.0] * (R - 2), dtype=np.float32)
+        cap = np.full((Q, R), np.inf, dtype=np.float32)
+        for i, c in enumerate(caps):
+            if c is not None:
+                cap[i] = c
+        out = water_fill_deserved(
+            np.asarray(total, np.float32), np.asarray(weights, np.float32),
+            cap, np.asarray(requests, np.float32), thr, max_iters=Q + 1)
+        return np.asarray(out)
+
+    def test_weight_split(self):
+        d = self._kernel_deserved(
+            total=[12000.0, 100e9], weights=[3.0, 1.0],
+            caps=[None, None],
+            requests=[[12000.0, 12e9], [12000.0, 12e9]])
+        assert d[0][0] == pytest.approx(9000, rel=1e-3)
+        assert d[1][0] == pytest.approx(3000, rel=1e-3)
+
+    def test_request_clamp_redistributes(self):
+        d = self._kernel_deserved(
+            total=[12000.0, 100e9], weights=[1.0, 1.0],
+            caps=[None, None],
+            requests=[[2000.0, 2e9], [20000.0, 20e9]])
+        assert d[0][0] == pytest.approx(2000, rel=1e-3)
+        assert d[1][0] == pytest.approx(10000, rel=1e-3)
+
+    def test_capability_clamp(self):
+        import numpy as np
+        d = self._kernel_deserved(
+            total=[12000.0, 100e9], weights=[1.0, 1.0],
+            caps=[np.array([3000.0, np.inf], np.float32), None],
+            requests=[[20000.0, 20e9], [20000.0, 20e9]])
+        assert d[0][0] == pytest.approx(3000, rel=1e-3)
+        assert d[1][0] == pytest.approx(9000, rel=1e-3)
+
+    def test_matches_host_plugin(self):
+        """Same inputs through the plugin's host water-fill and the kernel."""
+        queues = [build_queue("qa", weight=2), build_queue("qb", weight=1),
+                  build_queue("qc", weight=1)]
+        pgs = [build_pod_group("pga", queue="qa"),
+               build_pod_group("pgb", queue="qb"),
+               build_pod_group("pgc", queue="qc")]
+        pods = ([build_pod("default", f"a{i}", "", "Pending",
+                           {"cpu": "2", "memory": "2Gi"}, "pga")
+                 for i in range(10)]
+                + [build_pod("default", f"b{i}", "", "Pending",
+                             {"cpu": "1", "memory": "4Gi"}, "pgb")
+                   for i in range(3)]
+                + [build_pod("default", f"c{i}", "", "Pending",
+                             {"cpu": "1", "memory": "1Gi"}, "pgc")
+                   for i in range(20)])
+        nodes = [build_node("n1", {"cpu": "16", "memory": "64Gi"}),
+                 build_node("n2", {"cpu": "16", "memory": "64Gi"})]
+        store, cache = make_cluster(nodes, pgs, pods, queues)
+        tiers = [Tier(plugins=[PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="proportion"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers)
+        pp = ssn.plugins["proportion"]
+        total = [32000.0, float(2 * 64 * 2**30)]
+        weights, requests, caps = [], [], []
+        names = ["qa", "qb", "qc"]
+        for n in names:
+            attr = pp.queue_opts[n]
+            weights.append(attr.weight)
+            requests.append([attr.request.milli_cpu, attr.request.memory])
+            caps.append(None)
+        d = self._kernel_deserved(total, weights, caps, requests)
+        for i, n in enumerate(names):
+            assert d[i][0] == pytest.approx(
+                pp.queue_opts[n].deserved.milli_cpu, rel=1e-3), n
+            assert d[i][1] == pytest.approx(
+                pp.queue_opts[n].deserved.memory, rel=1e-3), n
+        close_session(ssn)

@@ -76,6 +76,13 @@ class AllocateAction(Action):
                 continue
             jobs = queue_map.get(queue.name)
             if jobs is None or jobs.empty():
+                # Exhausted queue: drop it and rescan the namespace. The
+                # reference instead relies on live share updates to steer
+                # the next pick away (allocate.go:160-166 allocates inline);
+                # this pre-solve collection has no updates, so an order tie
+                # would starve every other queue's jobs out of the flatten.
+                queue_map.pop(queue.name, None)
+                namespaces.push(ns)
                 continue
             job = jobs.pop()
             yield job
@@ -116,6 +123,14 @@ class AllocateAction(Action):
             {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
             queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None))
 
+        # queue fairness: when proportion is active its session-open attrs
+        # (allocated/request over ALL jobs, incl. running-only queues) feed
+        # the in-kernel water-fill + per-round deserved caps
+        queue_opts = ssn.solver_options.get("queue_opts")
+        use_queue_cap = bool(queue_opts)
+        if use_queue_cap:
+            self._fill_queue_arrays(arr, queue_opts, ssn)
+
         sp = ssn.score_params
         weights_fn = ssn.solver_options.get("binpack_vocab_weights")
         if weights_fn is not None:
@@ -143,11 +158,12 @@ class AllocateAction(Action):
 
         if sequential:
             res = solve_allocate_sequential(
-                arr.device_dict(), params, score_families=tuple(families))
+                arr.device_dict(), params, score_families=tuple(families),
+                use_queue_cap=use_queue_cap)
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
-                score_families=tuple(families))
+                score_families=tuple(families), use_queue_cap=use_queue_cap)
         assigned = np.asarray(res.assigned)
         kind = np.asarray(res.kind)
 
@@ -176,6 +192,43 @@ class AllocateAction(Action):
                 stmt.commit()
             else:
                 stmt.discard()
+
+    @staticmethod
+    def _fill_queue_arrays(arr, queue_opts, ssn) -> None:
+        """Overwrite the flatten's queue arrays from the proportion plugin's
+        per-queue attrs (weight/capability/allocated/request). Queues known
+        to the plugin but absent from the pending flatten (running-only
+        queues) still participate in the water-fill, so their weight share
+        is not redistributed to hungry queues (proportion.go:137-167)."""
+        from ..ops.arrays import bucket
+
+        vocab = arr.vocab
+        R = len(vocab)
+        names = list(arr.queues_list)
+        known = set(names)
+        names += [n for n in queue_opts if n not in known]
+        Q = bucket(max(len(names), 1))
+        weight = np.zeros(Q, dtype=np.float32)
+        cap = np.full((Q, R), np.inf, dtype=np.float32)
+        alloc = np.zeros((Q, R), dtype=np.float32)
+        req = np.zeros((Q, R), dtype=np.float32)
+        for i, n in enumerate(names):
+            attr = queue_opts.get(n)
+            if attr is None:
+                qi = ssn.queues.get(n)
+                weight[i] = getattr(qi, "weight", 1) or 1
+                req[i] = np.inf  # unknown demand: stays hungry
+                continue
+            weight[i] = attr.weight
+            alloc[i] = attr.allocated.to_vector(vocab)
+            req[i] = attr.request.to_vector(vocab)
+            if attr.capability is not None:
+                cap_vec = attr.capability.to_vector(vocab)
+                cap[i] = np.where(cap_vec > 0, cap_vec, np.inf)
+        arr.queue_weight = weight
+        arr.queue_capability = cap
+        arr.queue_allocated = alloc
+        arr.queue_request = req
 
     # ------------------------------------------------------------------
     # host mode (reference per-task loop)

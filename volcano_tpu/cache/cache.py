@@ -215,7 +215,10 @@ class SchedulerCache:
             if ti.node_name not in self.nodes:
                 self.nodes[ti.node_name] = NodeInfo()
                 self.nodes[ti.node_name].name = ti.node_name
-            self.nodes[ti.node_name].add_task(ti)
+            # Terminated tasks (Succeeded/Failed) hold no node resources
+            # (event_handlers.go:69-72 isTerminated gate).
+            if ti.status not in (TaskStatus.SUCCEEDED, TaskStatus.FAILED):
+                self.nodes[ti.node_name].add_task(ti)
 
     def add_pod(self, pod) -> None:
         if pod.scheduler_name != self.scheduler_name:
@@ -229,11 +232,18 @@ class SchedulerCache:
                 self.jobs[ti.job].delete_task_info(ti)
             except KeyError as e:
                 job_err = e
+        # skip node removal when the node never held the task (terminated
+        # tasks aren't added — the isTerminated gate in add_task; the
+        # reference logs a spurious error here instead). Membership, not
+        # ti.status, is the test: watch deliveries can alias old/new pod
+        # objects, and accounting uses the node's stored clone anyway.
         if ti.node_name and ti.node_name in self.nodes:
-            try:
-                self.nodes[ti.node_name].remove_task(ti)
-            except KeyError as e:
-                node_err = e
+            node = self.nodes[ti.node_name]
+            if ti.key in node.tasks:
+                try:
+                    node.remove_task(ti)
+                except KeyError as e:
+                    node_err = e
         if job_err or node_err:
             raise KeyError(f"failed to delete task {ti.key}: {job_err} {node_err}")
 

@@ -10,6 +10,7 @@ from .framework import (  # noqa: F401
 )
 from .garbagecollector import GarbageCollector  # noqa: F401
 from .job import JobController  # noqa: F401
+from .kubelet import KubeletStandin  # noqa: F401
 from .podgroup import PodGroupController  # noqa: F401
 from .queue import QueueController  # noqa: F401
 
@@ -28,6 +29,7 @@ class ControllerManager:
             JobController(),
             QueueController(),
             PodGroupController(),
+            KubeletStandin(),
             GarbageCollector(),
         ]
         for ctrl in self.controllers:

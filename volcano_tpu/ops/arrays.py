@@ -182,9 +182,10 @@ class SnapshotArrays:
     sig_masks: np.ndarray = None        # [S,N] bool
     # -- queues --------------------------------------------------------------
     queues_list: List[str] = field(default_factory=list)
-    queue_weight: np.ndarray = None     # [Q]
+    queue_weight: np.ndarray = None     # [Q] (0 = padded/absent queue)
     queue_capability: np.ndarray = None  # [Q,R] (inf where uncapped)
     queue_allocated: np.ndarray = None  # [Q,R]
+    queue_request: np.ndarray = None    # [Q,R] allocated + pending requests
     # -- misc ----------------------------------------------------------------
     thresholds: np.ndarray = None       # [R]
     scalar_dim_mask: np.ndarray = None  # [R] bool: dims 2+ (ignorable)
@@ -254,6 +255,10 @@ class SnapshotArrays:
             "node_max_pods": self.node_max_pods,
             "node_valid": self.node_valid,
             "sig_masks": self.sig_masks,
+            "queue_weight": self.queue_weight,
+            "queue_capability": self.queue_capability,
+            "queue_allocated": self.queue_allocated,
+            "queue_request": self.queue_request,
             "thresholds": self.thresholds,
             "scalar_dim_mask": self.scalar_dim_mask,
         }
@@ -596,12 +601,16 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
         cache.sig_rows[s] = (row_key, row)
         arr.sig_masks[s_idx] = row
 
-    # queues (water-filling inputs; filled further by proportion plugin)
+    # queues (water-filling inputs; overwritten by the allocate action from
+    # the proportion plugin's session-open attrs when proportion is active —
+    # those cover allocated/request across ALL jobs, not just pending ones)
     Q = bucket(max(len(queue_names), 1))
     arr.queues_list = queue_names
-    arr.queue_weight = np.ones(Q, dtype=np.float32)
+    arr.queue_weight = np.zeros(Q, dtype=np.float32)  # 0 = padded slot
+    arr.queue_weight[:len(queue_names)] = 1.0
     arr.queue_capability = np.full((Q, R), np.inf, dtype=np.float32)
     arr.queue_allocated = np.zeros((Q, R), dtype=np.float32)
+    arr.queue_request = np.zeros((Q, R), dtype=np.float32)
     if queues:
         for name, q_idx in queue_index.items():
             qi = queues.get(name)

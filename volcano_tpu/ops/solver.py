@@ -53,21 +53,26 @@ class SolveResult(NamedTuple):
 # building blocks
 # ---------------------------------------------------------------------------
 
-def fits_matrix(req, avail, thr, scalar_mask):
-    """LessEqual(req, avail) per (task, node): [T,N] bool.
+def le_fits(lhs, avail, thr, scalar_mask, ignore_req=None):
+    """Threshold-tolerant LessEqual reduced over the trailing resource axis
+    (resource_info.go LessEqual): a dim fits iff lhs < avail + thr OR
+    lhs <= avail — the <= disjunct keeps exact fits feasible, because at
+    memory magnitudes the threshold vanishes in float32 (2^30 + 1 rounds to
+    2^30). Scalar dims whose request (ignore_req, default lhs) is <= 10
+    milli are ignored entirely. All inputs broadcast against [..., R].
 
-    req [T,R], avail [N,R]; a dim fits iff req < avail + thr OR req <= avail;
-    scalar dims with req <= 10 are ignored entirely (resource_info.go
-    LessEqual).
+    Single source of truth for the fit rule — the round solver, sequential
+    solver, queue caps, and sharded admission all call this so a semantics
+    tweak can't desynchronize them.
     """
-    lhs = req[:, None, :]                       # [T,1,R]
-    rhs = avail[None, :, :] + thr[None, None, :]  # [1,N,R]
-    # the <= disjunct keeps exact fits feasible: at memory magnitudes the
-    # threshold vanishes in float32 (2^30 + 1 rounds to 2^30), so lhs < rhs
-    # alone would reject req == avail
-    dim_ok = (lhs < rhs) | (lhs <= avail[None, :, :])
-    ignored = scalar_mask[None, None, :] & (lhs <= 10.0)
-    return jnp.all(dim_ok | ignored, axis=-1)   # [T,N]
+    dim_ok = (lhs < avail + thr) | (lhs <= avail)
+    req = lhs if ignore_req is None else ignore_req
+    return jnp.all(dim_ok | (scalar_mask & (req <= 10.0)), axis=-1)
+
+
+def fits_matrix(req, avail, thr, scalar_mask):
+    """LessEqual(req, avail) per (task, node): [T,N] bool."""
+    return le_fits(req[:, None, :], avail[None, :, :], thr, scalar_mask)
 
 
 def score_matrix(init_req, idle, used, alloc, params,
@@ -108,6 +113,68 @@ def score_matrix(init_req, idle, used, alloc, params,
 
     score += params["node_static"][None, :]
     return score
+
+
+def water_fill_deserved(total, weight, cap, request, thr, max_iters: int):
+    """Iterative weighted water-filling of per-queue deserved resources
+    (proportion.go:137-197), vectorized over queues on device.
+
+    total [R]; weight [Q] (0 = absent/padded queue); cap [Q,R] with +inf on
+    uncapped dims; request [Q,R]. Each pass hands every unmet queue its
+    weight-proportional slice of the remaining pool simultaneously (the
+    reference's inner for-loop reads one `remaining` snapshot per pass, so
+    the pass is order-free); queues clamp at capability or request and stop
+    participating. Terminates when the pool is sub-threshold or all queues
+    met — at most Q+1 passes (an all-unmet pass drains the pool).
+    """
+
+    def cond(s):
+        deserved, meet, remaining, it = s
+        tw = jnp.sum(jnp.where(meet, 0.0, weight))
+        return (tw > 0) & jnp.any(remaining >= thr) & (it < max_iters)
+
+    def body(s):
+        deserved, meet, remaining, it = s
+        tw = jnp.sum(jnp.where(meet, 0.0, weight))
+        frac = jnp.where(meet, 0.0, weight) / jnp.maximum(tw, 1e-9)
+        old = deserved
+        grown = deserved + frac[:, None] * remaining[None, :]
+        cap_viol = jnp.any(grown > cap, axis=1)
+        req_less = jnp.all(request < grown, axis=1)
+        clamped = jnp.where(
+            cap_viol[:, None],
+            jnp.minimum(jnp.minimum(grown, cap), request),
+            jnp.where(req_less[:, None], jnp.minimum(grown, request), grown))
+        deserved = jnp.where(meet[:, None], deserved, clamped)
+        meet = meet | cap_viol | req_less
+        remaining = jnp.maximum(
+            remaining - jnp.sum(deserved - old, axis=0), 0.0)
+        return deserved, meet, remaining, it + 1
+
+    Q = weight.shape[0]
+    init = (jnp.zeros_like(request), weight <= 0, total, jnp.int32(0))
+    deserved, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return deserved
+
+
+def _queue_cap_mask(eligible, task_queue, req, qrem, rank, thr, scalar_mask):
+    """Per-round queue admission cap: among eligible tasks sorted by
+    (queue, rank), a task passes iff its queue's running prefix + its own
+    request still fits the queue's remaining deserved (threshold-tolerant,
+    like fits_matrix). Conservative like node prefix admission: a blocked
+    task waits for the next round's recomputed remaining."""
+    T = req.shape[0]
+    key = jnp.where(eligible, task_queue * (T + 1) + rank, BIG_KEY)
+    perm = jnp.argsort(key)
+    s_q = task_queue[perm]
+    s_act = eligible[perm]
+    s_req = req[perm] * s_act[:, None]
+    seg_start = jnp.concatenate([jnp.array([True]), s_q[1:] != s_q[:-1]])
+    prefix = _segment_prefix(s_req, seg_start)
+    s_rem = qrem[s_q]
+    ok_sorted = le_fits(prefix + s_req, s_rem, thr, scalar_mask,
+                        ignore_req=s_req) & s_act
+    return jnp.zeros(T, dtype=bool).at[perm].set(ok_sorted)
 
 
 def _segment_prefix(sorted_vals, seg_start_mask):
@@ -201,10 +268,8 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
     prefix = _segment_prefix(s_fit, seg_start)                     # [T,R]
 
     s_avail = avail[jnp.maximum(s_choice, 0)]                      # [T,R]
-    lhs = prefix + s_fit
-    dim_ok = (lhs < (s_avail + thr[None, :])) | (lhs <= s_avail)
-    ignored = scalar_mask[None, :] & (s_fit <= 10.0)
-    fits = jnp.all(dim_ok | ignored, axis=-1) & s_active
+    fits = le_fits(prefix + s_fit, s_avail, thr, scalar_mask,
+                   ignore_req=s_fit) & s_active
     # pod-count prefix: position within segment
     ones = jnp.ones_like(s_choice)
     pos = _segment_prefix(ones[:, None].astype(jnp.float32), seg_start)[:, 0]
@@ -234,15 +299,23 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
 
 @functools.partial(jax.jit, static_argnames=("max_rounds", "max_gang_iters",
                                              "per_node_cap", "herd_mode",
-                                             "score_families"))
+                                             "score_families",
+                                             "use_queue_cap"))
 def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    score_params: Dict[str, jnp.ndarray],
                    max_rounds: int = 64,
                    max_gang_iters: int = 8,
                    per_node_cap: int = 0,
                    herd_mode: str = "pack",
-                   score_families: Tuple[str, ...] = ("binpack", "kube")) -> SolveResult:
-    """Round-based allocate+pipeline solve with in-kernel gang semantics."""
+                   score_families: Tuple[str, ...] = ("binpack", "kube"),
+                   use_queue_cap: bool = False) -> SolveResult:
+    """Round-based allocate+pipeline solve with in-kernel gang semantics.
+
+    With ``use_queue_cap`` (proportion plugin active) per-queue deserved is
+    water-filled on device from queue_weight/capability/request and each
+    round's admissions are capped at deserved per queue, so a 3:1 weight
+    split of a saturated cluster yields a 3:1 allocation split.
+    """
     a = arrays
     T = a["task_init_req"].shape[0]
     N = a["node_idle"].shape[0]
@@ -253,19 +326,39 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     rank = a["task_rank"]
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
 
+    if use_queue_cap:
+        Q = a["queue_weight"].shape[0]
+        total = jnp.sum(
+            a["node_alloc"] * a["node_valid"][:, None].astype(jnp.float32),
+            axis=0)
+        deserved = water_fill_deserved(
+            total, a["queue_weight"], a["queue_capability"],
+            a["queue_request"], thr, max_iters=Q + 1)
+        task_queue = a["job_queue"][a["task_job"]]
+        qalloc0 = a["queue_allocated"]
+    else:
+        task_queue = None
+        deserved = None
+        qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
+
     def phase_rounds(st, use_future: bool):
         """Run admission rounds to fixpoint against idle (allocate) or
-        future-idle (pipeline). st: 7-tuple carry."""
+        future-idle (pipeline). st: 8-tuple carry."""
 
         def cond(s):
             changed, rounds = s[-1], s[-2]
             return changed & (rounds < max_rounds)
 
         def body(s):
-            idle, pipe, npods, assigned, kind, excluded, rounds, _ = s
+            idle, pipe, npods, qalloc, assigned, kind, excluded, rounds, _ = s
             avail = (idle + a["node_extra_future"] - pipe) if use_future else idle
             eligible = (a["task_valid"] & (assigned < 0)
                         & ~excluded[a["task_job"]])
+            if use_queue_cap:
+                qrem = jnp.maximum(deserved - qalloc, 0.0)
+                eligible = eligible & _queue_cap_mask(
+                    eligible, task_queue, a["task_req"], qrem, rank, thr,
+                    scalar_mask)
             feas = fits_matrix(a["task_init_req"], avail, thr, scalar_mask) & sig_feas
             used_now = a["node_used"] + (a["node_idle"] - idle)
             score = score_matrix(a["task_init_req"], avail, used_now,
@@ -278,29 +371,35 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             got = new_assign >= 0
             assigned = jnp.where(got, new_assign, assigned)
             kind = jnp.where(got, jnp.int32(1 if use_future else 0), kind)
+            if use_queue_cap:
+                # pipelined tasks count toward queue allocated too (the
+                # reference fires AllocateFunc handlers on ssn.Pipeline)
+                qalloc = qalloc + jax.ops.segment_sum(
+                    a["task_req"] * got[:, None], task_queue,
+                    num_segments=Q)
             if use_future:
                 pipe = pipe + debit
             else:
                 idle = idle - debit
                 npods = npods + pod_inc
-            return (idle, pipe, npods, assigned, kind, excluded,
+            return (idle, pipe, npods, qalloc, assigned, kind, excluded,
                     rounds + 1, jnp.any(got))
 
         # skip the phase outright when no task is still eligible (e.g. the
         # pipeline phase after everything allocated): one [T] reduction
         # instead of a full wasted [T,N] round
-        _, _, _, assigned0, _, excluded0, _ = st
+        _, _, _, _, assigned0, _, excluded0, _ = st
         any_eligible = jnp.any(a["task_valid"] & (assigned0 < 0)
                                & ~excluded0[a["task_job"]])
         out = jax.lax.while_loop(cond, body, st + (any_eligible,))
         return out[:-1]
 
     def gang_body(s):
-        idle, pipe, npods, assigned, kind, excluded, rounds, _, it = s
-        st = (idle, pipe, npods, assigned, kind, excluded, rounds)
+        idle, pipe, npods, qalloc, assigned, kind, excluded, rounds, _, it = s
+        st = (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds)
         st = phase_rounds(st, use_future=False)
         st = phase_rounds(st, use_future=True)
-        idle, pipe, npods, assigned, kind, excluded, rounds = st
+        idle, pipe, npods, qalloc, assigned, kind, excluded, rounds = st
 
         # gang check: allocated (kind 0, counts_ready) per job
         alloc_counts = jax.ops.segment_sum(
@@ -328,14 +427,19 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             jnp.maximum(assigned, 0), num_segments=N)
         idle = idle + credit
         npods = npods - pod_credit
+        if use_queue_cap:
+            qalloc = qalloc - jax.ops.segment_sum(
+                a["task_req"] * revert_task[:, None], task_queue,
+                num_segments=Q)
         assigned = jnp.where(revert_task, -1, assigned)
         kind = jnp.where(revert_task, -1, kind)
         excluded = excluded | revert_job
         any_revert = jnp.any(revert_job)
-        return (idle, pipe, npods, assigned, kind, excluded, rounds,
+        return (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
                 any_revert, it + 1)
 
     init = (a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"],
+            qalloc0,
             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
             ~a["job_valid"], jnp.int32(0), jnp.bool_(True), jnp.int32(0))
     # bounded gang fixpoint: rerun phases while any job got reverted (its
@@ -343,7 +447,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     s = jax.lax.while_loop(
         lambda s: s[-2] & (s[-1] < max_gang_iters), gang_body, init)
 
-    idle, pipe, npods, assigned, kind, excluded, rounds, _, _ = s
+    idle, pipe, npods, _, assigned, kind, excluded, rounds, _, _ = s
     alloc_counts = jax.ops.segment_sum(
         ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
         a["task_job"], num_segments=J)
@@ -357,10 +461,12 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
 # sequential parity solver (reference greedy semantics)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("score_families",))
+@functools.partial(jax.jit, static_argnames=("score_families",
+                                             "use_queue_cap"))
 def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
                               score_params: Dict[str, jnp.ndarray],
-                              score_families: Tuple[str, ...] = ("binpack", "kube")) -> SolveResult:
+                              score_families: Tuple[str, ...] = ("binpack", "kube"),
+                              use_queue_cap: bool = False) -> SolveResult:
     """lax.scan over tasks in rank order: task k's allocation is visible to
     task k+1 and job-boundary gang revert mirrors Statement.Discard.
 
@@ -375,44 +481,61 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
     scalar_mask = a["scalar_dim_mask"]
     sig_feas_all = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
 
+    if use_queue_cap:
+        Q = a["queue_weight"].shape[0]
+        total = jnp.sum(
+            a["node_alloc"] * a["node_valid"][:, None].astype(jnp.float32),
+            axis=0)
+        deserved = water_fill_deserved(
+            total, a["queue_weight"], a["queue_capability"],
+            a["queue_request"], thr, max_iters=Q + 1)
+        qalloc0 = a["queue_allocated"]
+    else:
+        deserved = None
+        qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
+
     def fits_one(req, avail):
-        lhs = req[None, :]
-        dim_ok = (lhs < avail + thr[None, :]) | (lhs <= avail)
-        ignored = scalar_mask[None, :] & (lhs <= 10.0)
-        return jnp.all(dim_ok | ignored, axis=-1)
+        return le_fits(req[None, :], avail, thr, scalar_mask)
 
     def finalize_job(carry, jidx):
         """Gang-check job jidx; revert its allocations if unready (pipelined
         tasks survive discard, mirroring ssn.Pipeline being outside the
         Statement in allocate.go)."""
-        (idle, pipe, npods, assigned, kind, jalloc,
+        (idle, pipe, npods, qalloc, assigned, kind, jalloc,
          snap_idle, snap_pipe, snap_npods) = carry
         ready = (a["job_ready_base"][jidx] + jalloc) >= a["job_min"][jidx]
         is_job = (a["task_job"] == jidx)
         revert = is_job & (assigned >= 0) & (kind == 0) & ~ready
         idle = jnp.where(ready, idle, snap_idle)
         npods = jnp.where(ready, npods, snap_npods)
+        if use_queue_cap:
+            # pipelined tasks survive discard, so credit back only the
+            # reverted allocations (not a snapshot restore)
+            amt = jnp.sum(a["task_req"] * revert[:, None], axis=0)
+            jq = a["job_queue"][jidx]
+            qalloc = qalloc - (jnp.arange(Q) == jq)[:, None] * amt[None, :]
         assigned = jnp.where(revert, -1, assigned)
         kind = jnp.where(revert, -1, kind)
-        return (idle, pipe, npods, assigned, kind)
+        return (idle, pipe, npods, qalloc, assigned, kind)
 
     def step(carry, i):
-        (idle, pipe, npods, assigned, kind, cur_job, jalloc,
+        (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
          snap_idle, snap_pipe, snap_npods) = carry
         jidx = a["task_job"][i]
         boundary = (jidx != cur_job)
 
         def at_boundary(args):
-            (idle, pipe, npods, assigned, kind, jalloc,
+            (idle, pipe, npods, qalloc, assigned, kind, jalloc,
              snap_idle, snap_pipe, snap_npods) = args
-            idle, pipe, npods, assigned, kind = finalize_job(args, cur_job)
-            return (idle, pipe, npods, assigned, kind, jnp.int32(0),
+            idle, pipe, npods, qalloc, assigned, kind = \
+                finalize_job(args, cur_job)
+            return (idle, pipe, npods, qalloc, assigned, kind, jnp.int32(0),
                     idle, pipe, npods)
 
-        (idle, pipe, npods, assigned, kind, jalloc,
+        (idle, pipe, npods, qalloc, assigned, kind, jalloc,
          snap_idle, snap_pipe, snap_npods) = jax.lax.cond(
             boundary, at_boundary, lambda args: args,
-            (idle, pipe, npods, assigned, kind, jalloc,
+            (idle, pipe, npods, qalloc, assigned, kind, jalloc,
              snap_idle, snap_pipe, snap_npods))
         cur_job = jidx
 
@@ -421,6 +544,10 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
         req_acct = a["task_req"][i]
         sig_feas = sig_feas_all[i]
         pods_ok = npods < a["node_max_pods"]
+        if use_queue_cap:
+            jq = a["job_queue"][jidx]
+            valid = valid & le_fits(qalloc[jq] + req_acct, deserved[jq],
+                                    thr, scalar_mask, ignore_req=req_acct)
 
         feas_idle = fits_one(req_fit, idle) & sig_feas & pods_ok & valid
         future = idle + a["node_extra_future"] - pipe
@@ -443,23 +570,27 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
         idle = idle - jnp.where(pick_idle, debit[None, :] * onehot, 0.0)
         pipe = pipe + jnp.where(pick_fut, debit[None, :] * onehot, 0.0)
         npods = npods + jnp.where(pick_idle, onehot[:, 0].astype(jnp.int32), 0)
+        if use_queue_cap:
+            q_onehot = (jnp.arange(Q) == a["job_queue"][jidx])[:, None]
+            qalloc = qalloc + q_onehot * debit[None, :]
         assigned = assigned.at[i].set(node)
         kind = kind.at[i].set(jnp.where(pick_idle, 0,
                                         jnp.where(pick_fut, 1, -1)))
         jalloc = jalloc + jnp.where(
             pick_idle & a["task_counts_ready"][i], 1, 0)
-        return (idle, pipe, npods, assigned, kind, cur_job, jalloc,
+        return (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
                 snap_idle, snap_pipe, snap_npods), None
 
     init = (a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"],
+            qalloc0,
             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
             a["task_job"][0], jnp.int32(0),
             a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"])
     carry, _ = jax.lax.scan(step, init, jnp.arange(T))
-    (idle, pipe, npods, assigned, kind, cur_job, jalloc,
+    (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
      snap_idle, snap_pipe, snap_npods) = carry
-    idle, pipe, npods, assigned, kind = finalize_job(
-        (idle, pipe, npods, assigned, kind, jalloc,
+    idle, pipe, npods, qalloc, assigned, kind = finalize_job(
+        (idle, pipe, npods, qalloc, assigned, kind, jalloc,
          snap_idle, snap_pipe, snap_npods), cur_job)
 
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
@@ -489,16 +620,18 @@ def _unpack(fbuf, ibuf, layout):
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
-    "score_families"))
+    "score_families", "use_queue_cap"))
 def solve_allocate_packed(fbuf, ibuf, layout,
                           score_params: Dict[str, jnp.ndarray],
                           max_rounds: int = 64,
                           max_gang_iters: int = 8,
                           per_node_cap: int = 0,
                           herd_mode: str = "pack",
-                          score_families: Tuple[str, ...] = ("binpack",)) -> SolveResult:
+                          score_families: Tuple[str, ...] = ("binpack",),
+                          use_queue_cap: bool = False) -> SolveResult:
     """solve_allocate over buffers produced by SnapshotArrays.packed():
     the unpack is free on device (slices fuse), the transfer is 2 puts."""
     arrays = _unpack(fbuf, ibuf, layout)
     return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
-                          per_node_cap, herd_mode, score_families)
+                          per_node_cap, herd_mode, score_families,
+                          use_queue_cap)

@@ -33,7 +33,8 @@ except ImportError:  # older jax: experimental API, check_rep spelling
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.solver import (
-    NEG, BIG_KEY, SolveResult, _segment_prefix, fits_matrix, score_matrix,
+    NEG, BIG_KEY, SolveResult, _segment_prefix, fits_matrix, le_fits,
+    score_matrix,
 )
 
 
@@ -169,9 +170,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 [jnp.array([True]), s_choice[1:] != s_choice[:-1]])
             prefix = _segment_prefix(s_fit, seg_start)
             s_avail = avail[jnp.maximum(s_choice, 0)]
-            dim_ok = (prefix + s_fit) < (s_avail + thr[None, :])
-            ignored = scalar_mask[None, :] & (s_fit <= 10.0)
-            fits = jnp.all(dim_ok | ignored, axis=-1) & s_active
+            fits = le_fits(prefix + s_fit, s_avail, thr, scalar_mask,
+                           ignore_req=s_fit) & s_active
             ones = jnp.ones_like(s_choice)
             pos = _segment_prefix(
                 ones[:, None].astype(jnp.float32), seg_start)[:, 0]
@@ -269,6 +269,9 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         kernel, mesh=mesh,
         in_specs=(in_specs, params_spec),
         out_specs=(P(), P(), P(), P()))
-    assigned, kind, job_ready, rounds = mapped(dict(a), dict(score_params))
+    # device_dict may carry extra arrays (queue fairness) this kernel
+    # doesn't consume; keep the pytree congruent with in_specs
+    assigned, kind, job_ready, rounds = mapped(
+        {k: a[k] for k in in_specs}, dict(score_params))
     return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
                        rounds=rounds)

@@ -139,6 +139,10 @@ class ProportionPlugin(Plugin):
 
         ssn.add_queue_order_fn(self.name(), queue_order_fn)
 
+        # publish per-queue attrs so the allocate solver can water-fill
+        # deserved on device and cap per-round admissions per queue
+        ssn.solver_options["queue_opts"] = self.queue_opts
+
         def reclaimable_fn(reclaimer, reclaimees):
             victims = []
             allocations: Dict[str, Resource] = {}
