@@ -106,6 +106,30 @@ class TestAllocateAction:
         run_allocate(cache, gang_tiers(), mode)
         assert cache.binder.binds == {"c1/p1": "n2"}
 
+    def test_required_anti_affinity_not_colocated(self, mode):
+        # Two anti-affine pods must land on different nodes in EVERY mode:
+        # required inter-pod terms force the sequential host loop (the
+        # kernel's precomputed masks can't see in-flight placements), so the
+        # solver-mode kernel can no longer co-locate them.
+        anti = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"app": "db"}},
+                 "topologyKey": "kubernetes.io/hostname"}]}}
+        pods = []
+        for i in (1, 2):
+            p = build_pod("c1", f"p{i}", "", "Pending",
+                          {"cpu": "1", "memory": "1Gi"}, "pg1",
+                          labels={"app": "db"})
+            p.affinity = anti
+            pods.append(p)
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "8", "memory": "16Gi"}),
+             build_node("n2", {"cpu": "8", "memory": "16Gi"})],
+            [build_pod_group("pg1", "c1", min_member=2)], pods)
+        run_allocate(cache, gang_tiers(), mode)
+        assert len(cache.binder.binds) == 2
+        assert cache.binder.binds["c1/p1"] != cache.binder.binds["c1/p2"]
+
     def test_pending_phase_podgroup_skipped(self, mode):
         pg = build_pod_group("pg1", "c1", min_member=1,
                              phase=PodGroupPhase.PENDING)

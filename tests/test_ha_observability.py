@@ -73,6 +73,45 @@ class TestLeaderElection:
         assert a.step() is False
         assert log == ["a+", "b+", "a-"]
 
+    def test_interleaved_takeover_no_split_brain(self):
+        """Two standbys both observe an expired lease and both write; the
+        stale resource_version write must lose (optimistic concurrency on
+        the lease object, like resourcelock's update precondition)."""
+        store, clock, log = ClusterStore(), FakeClock(), []
+        a = self._elector(store, "a", clock, log)
+        b = self._elector(store, "b", clock, log)
+        c = self._elector(store, "c", clock, log)
+        a.step()
+        clock.t += a.lease_duration + 1  # a is gone, lease expired
+        # b and c read the expired lease concurrently...
+        stale_b, stale_c = [b.lock.get()], [c.lock.get()]
+        assert stale_b[0] is not store.get("leases", "volcano"), \
+            "lock.get must return a copy, not the live stored object"
+        b.lock.get = lambda: stale_b.pop() if stale_b else LeaseLock.get(b.lock)
+        c.lock.get = lambda: stale_c.pop() if stale_c else LeaseLock.get(c.lock)
+        # ...and both try to take over: first write wins, second conflicts
+        assert b.step() is True
+        assert c.step() is False
+        assert not c.is_leader
+        assert store.get("leases", "volcano").holder_identity == "b"
+        # c converges to standby on its next (fresh) read
+        assert c.step() is False
+
+    def test_interleaved_first_acquisition_no_split_brain(self):
+        """Empty store: two electors both read 'no lease' and both write.
+        The second write must go through create (version 0 = never read a
+        stored lease) and conflict, not silently overwrite the winner."""
+        store, clock, log = ClusterStore(), FakeClock(), []
+        a = self._elector(store, "a", clock, log)
+        b = self._elector(store, "b", clock, log)
+        none_b = [None]  # b's concurrent read saw no lease
+        b.lock.get = lambda: none_b.pop() if none_b else LeaseLock.get(b.lock)
+        assert a.step() is True
+        assert b.step() is False
+        assert not b.is_leader
+        assert store.get("leases", "volcano").holder_identity == "a"
+        assert log == ["a+"]
+
 
 class TestMetricsServer:
     def test_serves_metrics_healthz_stacks(self):

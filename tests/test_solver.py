@@ -269,6 +269,38 @@ class TestFlattenCache:
         n1_idx = [n.name for n in arr2.nodes_list].index("n1")
         assert arr2.node_idle[n1_idx, 0] == 7000.0  # 8 cores - 1 allocated
 
+    def test_diverged_clone_cannot_alias_cache_key(self):
+        """A session clone and the live cache object mutated independently
+        after the clone must never share a flat_version (the flatten cache
+        would silently serve one's rows for the other). Versions come from a
+        global counter, so any two post-clone mutations produce distinct
+        versions."""
+        from volcano_tpu.ops import FlattenCache
+
+        jobs, nodes, tasks = make_problem(
+            [("n1", "8", "16Gi")],
+            [("j1", 2, [("1", "1Gi"), ("2", "2Gi")])])
+        live = nodes["n1"]
+        session = live.clone()
+        assert session.flat_version == live.flat_version  # warm reuse OK
+
+        # session (e.g. a preempt-first conf) allocates the 1-CPU task...
+        tasks_by_cpu = sorted(tasks, key=lambda t: t.resreq.milli_cpu)
+        t0, t1 = tasks_by_cpu[0], tasks_by_cpu[1]
+        session.add_task(t0.clone())
+        # ...while the live object later takes a different mutation
+        live.add_task(t1.clone())
+        assert session.flat_version != live.flat_version
+        # and flattening one then the other never reuses the stale row
+        # (note: a flatten's arrays alias the cache's internal buffers and
+        # are only valid until the next flatten against the same cache —
+        # the session consumes them before the next cycle)
+        fc = FlattenCache()
+        arr_s = flatten_snapshot(jobs, {"n1": session}, tasks, cache=fc)
+        assert arr_s.node_idle[0, 0] == 7000.0  # 8 - 1
+        arr_l = flatten_snapshot(jobs, {"n1": live}, tasks, cache=fc)
+        assert arr_l.node_idle[0, 0] == 6000.0  # 8 - 2, not a stale 7000
+
     def test_vocab_growth_on_new_scalar(self):
         from volcano_tpu.ops import FlattenCache
         from volcano_tpu.api import JobInfo, TaskInfo

@@ -16,6 +16,7 @@ from .types import (
     POD_GROUP_ANNOTATION,
     TaskStatus,
     allocated_status,
+    next_flat_version,
 )
 from .unschedule_info import FitErrors
 
@@ -143,7 +144,7 @@ class JobInfo:
     # -- podgroup binding ---------------------------------------------------
 
     def set_pod_group(self, pg) -> None:
-        self.flat_version += 1
+        self.flat_version = next_flat_version()
         self.name = pg.name
         self.namespace = pg.namespace
         self.queue = pg.spec.queue
@@ -165,7 +166,7 @@ class JobInfo:
                 del self.task_status_index[ti.status]
 
     def add_task_info(self, ti: TaskInfo) -> None:
-        self.flat_version += 1
+        self.flat_version = next_flat_version()
         self.tasks[ti.key] = ti
         self._add_to_index(ti)
         if allocated_status(ti.status):
@@ -181,7 +182,7 @@ class JobInfo:
         self.total_request.sub(task.resreq)
         del self.tasks[task.key]
         self._remove_from_index(task)
-        self.flat_version += 1
+        self.flat_version = next_flat_version()
 
     def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
         """Delete + reinsert keeping index/aggregates consistent

@@ -16,7 +16,7 @@ from .device_info import (
 )
 from .job_info import TaskInfo
 from .resource import Resource
-from .types import NodePhase, TaskStatus
+from .types import NodePhase, TaskStatus, next_flat_version
 
 
 class NodeState:
@@ -76,7 +76,7 @@ class NodeInfo:
     def set_node(self, node) -> None:
         """Rebuild resource views from node.allocatable, replaying held tasks
         (node_info.go:171-210)."""
-        self.flat_version += 1
+        self.flat_version = next_flat_version()
         self.spec_version += 1
         if not self._check_ready(node):
             # Keep self.node unset (reference keeps ni.Node nil) so held
@@ -162,7 +162,7 @@ class NodeInfo:
                 f"task <{task.key}> already on different node <{task.node_name}>")
         if task.key in self.tasks:
             raise ValueError(f"task <{task.key}> already on node <{self.name}>")
-        self.flat_version += 1
+        self.flat_version = next_flat_version()
         ti = task.clone()
         if self.node is not None:
             if ti.status == TaskStatus.RELEASING:
@@ -183,7 +183,7 @@ class NodeInfo:
         task = self.tasks.get(ti.key)
         if task is None:
             raise KeyError(f"failed to find task <{ti.key}> on host <{self.name}>")
-        self.flat_version += 1
+        self.flat_version = next_flat_version()
         if self.node is not None:
             if task.status == TaskStatus.RELEASING:
                 self.releasing.sub(task.resreq)

@@ -3,6 +3,19 @@
 from __future__ import annotations
 
 import enum
+import itertools
+
+#: Global, never-repeating flatten-version source. Every mutation of a
+#: JobInfo/NodeInfo takes a fresh value instead of incrementing a private
+#: counter, so a session clone and the live cache object that diverge after
+#: the clone can never alias the same (name, flat_version) flatten-cache key
+#: — while an unmutated clone still carries its source's version and keeps
+#: the cache warm.
+_FLAT_VERSION_COUNTER = itertools.count(1)
+
+
+def next_flat_version() -> int:
+    return next(_FLAT_VERSION_COUNTER)
 
 
 class TaskStatus(enum.IntEnum):

@@ -102,6 +102,18 @@ class ClusterStore:
         old = bucket.get(key)
         if old is None:
             raise NotFoundError(f"{kind} {key} not found")
+        # Optimistic concurrency: a writer presenting a stale copy loses
+        # (k8s resourceVersion precondition). Only enforced when the caller
+        # hands in a *different* object carrying a version — in-place updates
+        # of the stored object (the informer-cache pattern) and fresh objects
+        # with version 0 carry no precondition.
+        if (obj is not old
+                and getattr(obj, "resource_version", 0)
+                and getattr(old, "resource_version", 0)
+                and obj.resource_version != old.resource_version):
+            raise ConflictError(
+                f"{kind} {key}: stale resource_version "
+                f"{obj.resource_version} != {old.resource_version}")
         self._rv += 1
         if hasattr(obj, "resource_version"):
             obj.resource_version = self._rv

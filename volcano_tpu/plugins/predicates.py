@@ -13,7 +13,9 @@ InterPodAffinity. Two forms:
 
 from __future__ import annotations
 
-from ..api import FitError
+import logging
+
+from ..api import FitError, TaskStatus
 from ..api.device_info import (
     add_gpu_index, get_gpu_index, gpu_resource_of_pod, predicate_gpu,
     remove_gpu_index,
@@ -28,11 +30,25 @@ from ..ops.arrays import (
     _match_node_selector, _node_affinity_match, _tolerates,
 )
 
+logger = logging.getLogger(__name__)
+
 
 class PredicateError(Exception):
     def __init__(self, fit_error: FitError):
         super().__init__(fit_error.error())
         self.fit_error = fit_error
+
+
+def _has_required_pod_affinity(pod) -> bool:
+    """True when the pod carries requiredDuringScheduling inter-pod
+    (anti-)affinity terms — feasibility then depends on in-flight placements,
+    which only the sequential host loop tracks."""
+    aff = pod.affinity or {}
+    for kind in ("podAffinity", "podAntiAffinity"):
+        if (aff.get(kind) or {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution"):
+            return True
+    return False
 
 
 def _pod_affinity_ok(pod, node, tasks_on_node) -> bool:
@@ -68,6 +84,20 @@ class PredicatesPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         ssn.solver_options["predicates"] = True
+        # The batched kernel's feasibility masks are precomputed per node and
+        # cannot see in-flight same-session placements, so required inter-pod
+        # (anti-)affinity must run the sequential host loop (the same gate the
+        # GPU-sharing predicate uses). Mirrors predicates.go:171-237
+        # InterPodAffinity being a full k8s filter in the reference.
+        # Only pending tasks matter: _pod_affinity_ok evaluates the incoming
+        # pod's terms, never existing pods' (no anti-affinity symmetry), so a
+        # long-Running affine pod must not downgrade every cycle to host mode.
+        for job in ssn.jobs.values():
+            if any(_has_required_pod_affinity(t.pod)
+                   for t in job.task_status_index.get(
+                       TaskStatus.PENDING, {}).values()):
+                ssn.solver_options["force_host_allocate"] = True
+                break
         if self.gpu_sharing:
             # per-card feasibility depends on in-flight card assignments, so
             # the allocate pass must run the sequential host loop
@@ -92,6 +122,14 @@ class PredicatesPlugin(Plugin):
                 else:
                     dev_id = predicate_gpu(pod, node_info)
                 if dev_id < 0:
+                    # node-level gpu memory was just accounted for this task
+                    # but no card fits: surface the inconsistency instead of
+                    # silently leaving the pod without a card assignment
+                    # (predicates.go:117-133 logs the allocate error)
+                    logger.error(
+                        "gpu allocate: no card on node <%s> fits pod <%s/%s> "
+                        "(node accounting and card assignment now disagree)",
+                        task.node_name, pod.namespace, pod.name)
                     return
                 add_gpu_index(pod, dev_id)
                 dev = node_info.gpu_devices.get(dev_id)

@@ -13,11 +13,14 @@ tests are deterministic; ``run()`` is the wall-clock loop.
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+from volcano_tpu.client.store import ConflictError, NotFoundError
 
 LEASE_DURATION = 15.0   # server.go:50
 RENEW_DEADLINE = 10.0   # server.go:51
@@ -47,12 +50,19 @@ class LeaseLock:
 
     def get(self) -> Optional[Lease]:
         try:
-            return self.store.get("leases", self.name)
+            # a copy, so the elector's mutations never leak into the store and
+            # the carried resource_version acts as a write precondition
+            return copy.copy(self.store.get("leases", self.name))
         except Exception:
             return None
 
     def create_or_update(self, lease: Lease) -> Lease:
-        return self.store.apply("leases", lease)
+        # A lease the elector read as absent (version 0) must go through
+        # create so two racing first-acquirers conflict instead of the second
+        # overwriting the first via the version-0 update bypass.
+        if lease.resource_version:
+            return self.store.update("leases", lease)
+        return self.store.create("leases", lease)
 
 
 class LeaderElector:
@@ -112,14 +122,28 @@ class LeaderElector:
         new.lease_duration_seconds = self.lease_duration
         try:
             self.lock.create_or_update(new)
+        except ConflictError:
+            # another elector wrote the lease between our read and our write:
+            # the write with the stale resource_version loses (no split brain)
+            cur = self.lock.get()
+            if cur is not None and cur.holder_identity == self.identity:
+                self._last_renew = now
+                self._win()
+                return True
+            if self.is_leader:
+                self._lose()
+            return False
         except Exception:
             return self.is_leader
         self._last_renew = now
+        self._win()
+        return True
+
+    def _win(self) -> None:
         if not self.is_leader:
             self.is_leader = True
             if self.on_started_leading is not None:
                 self.on_started_leading()
-        return True
 
     def _lose(self) -> None:
         self.is_leader = False
@@ -132,7 +156,10 @@ class LeaderElector:
         if lease is not None and lease.holder_identity == self.identity:
             lease.renew_time = 0.0
             lease.holder_identity = ""
-            self.lock.create_or_update(lease)
+            try:
+                self.lock.create_or_update(lease)
+            except (ConflictError, NotFoundError):
+                pass  # already taken over or deleted; nothing to release
         if self.is_leader:
             self._lose()
 
