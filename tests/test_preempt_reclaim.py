@@ -6,12 +6,23 @@ import pytest
 from volcano_tpu.api import TaskStatus
 from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
 from volcano_tpu.client import ClusterStore
-from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.conf import Configuration, PluginOption, Tier
 from volcano_tpu.framework import close_session, get_action, open_session
 from volcano_tpu.models import PriorityClass
 from volcano_tpu.utils.scheduler_helper import reservation
 
 from helpers import build_node, build_pod, build_pod_group, build_queue
+
+
+@pytest.fixture(params=["solver", "host"])
+def mode(request):
+    return request.param
+
+
+def open_mode(cache, tiers, mode):
+    return open_session(cache, tiers,
+                        [Configuration("preempt", {"mode": mode}),
+                         Configuration("reclaim", {"mode": mode})])
 
 
 def make_cluster(nodes, podgroups, pods, queues=(), priority_classes=()):
@@ -34,7 +45,7 @@ def make_cluster(nodes, podgroups, pods, queues=(), priority_classes=()):
 
 
 class TestPreempt:
-    def test_high_priority_job_preempts_within_queue(self):
+    def test_high_priority_job_preempts_within_queue(self, mode):
         """preempt_test.go case: node full with low-prio job; high-prio job
         with pending tasks evicts victims and pipelines."""
         low_pg = build_pod_group("low", "c1", min_member=1)
@@ -55,7 +66,7 @@ class TestPreempt:
                                PluginOption(name="conformance")]),
                  Tier(plugins=[PluginOption(name="predicates"),
                                PluginOption(name="nodeorder")])]
-        ssn = open_session(cache, tiers)
+        ssn = open_mode(cache, tiers, mode)
         get_action("preempt").execute(ssn)
         assert len(cache.evictor.evicts) >= 1
         assert all(e.startswith("c1/low") for e in cache.evictor.evicts)
@@ -63,7 +74,7 @@ class TestPreempt:
         assert high_job.waiting_task_num() == 1  # pipelined
         close_session(ssn)
 
-    def test_no_preemption_between_equal_priority(self):
+    def test_no_preemption_between_equal_priority(self, mode):
         pg_a = build_pod_group("a", "c1", min_member=1)
         pg_b = build_pod_group("b", "c1", min_member=1)
         store, cache = make_cluster(
@@ -76,12 +87,12 @@ class TestPreempt:
         tiers = [Tier(plugins=[PluginOption(name="priority"),
                                PluginOption(name="gang"),
                                PluginOption(name="conformance")])]
-        ssn = open_session(cache, tiers)
+        ssn = open_mode(cache, tiers, mode)
         get_action("preempt").execute(ssn)
         assert cache.evictor.evicts == []
         close_session(ssn)
 
-    def test_conformance_protects_kube_system(self):
+    def test_conformance_protects_kube_system(self, mode):
         sys_pg = build_pod_group("sys", "kube-system", min_member=1)
         high_pg = build_pod_group("high", "c1", min_member=1)
         high_pg.spec.priority_class_name = "high-priority"
@@ -97,14 +108,63 @@ class TestPreempt:
         tiers = [Tier(plugins=[PluginOption(name="priority"),
                                PluginOption(name="gang"),
                                PluginOption(name="conformance")])]
-        ssn = open_session(cache, tiers)
+        ssn = open_mode(cache, tiers, mode)
         get_action("preempt").execute(ssn)
         assert cache.evictor.evicts == []
         close_session(ssn)
 
 
+class TestGangPreempt:
+    """BASELINE config #4 in miniature: a high-priority gang claims room
+    held by a low-priority job — all-or-nothing."""
+
+    def _cluster(self, n_nodes, low_pods_per_node, min_member, mode):
+        low_pg = build_pod_group("low", "c1", min_member=1)
+        high_pg = build_pod_group("high", "c1", min_member=min_member)
+        high_pg.spec.priority_class_name = "high-priority"
+        pods = []
+        for n in range(n_nodes):
+            for i in range(low_pods_per_node):
+                pods.append(build_pod(
+                    "c1", f"low-{n}-{i}", f"n{n}", "Running",
+                    {"cpu": "1", "memory": "1Gi"}, "low"))
+        for i in range(min_member):
+            pods.append(build_pod("c1", f"high-{i}", "", "Pending",
+                                  {"cpu": "1", "memory": "1Gi"}, "high"))
+        store, cache = make_cluster(
+            [build_node(f"n{n}", {"cpu": "2", "memory": "8Gi"})
+             for n in range(n_nodes)],
+            [low_pg, high_pg], pods,
+            priority_classes=[PriorityClass("high-priority", 1000)])
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang"),
+                               PluginOption(name="conformance")]),
+                 Tier(plugins=[PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_mode(cache, tiers, mode)
+        return store, cache, ssn
+
+    def test_gang_preempts_across_nodes(self, mode):
+        # 2 full nodes (2x2 low pods); high gang of 3 must evict 3 victims
+        # spread over both nodes and pipeline all 3
+        store, cache, ssn = self._cluster(2, 2, 3, mode)
+        get_action("preempt").execute(ssn)
+        assert len(cache.evictor.evicts) == 3
+        assert all(e.startswith("c1/low") for e in cache.evictor.evicts)
+        assert ssn.jobs["c1/high"].waiting_task_num() == 3
+        close_session(ssn)
+
+    def test_gang_unsatisfiable_reverts_all_evictions(self, mode):
+        # high gang of 5 can never fit 2x2-CPU nodes: NOTHING may be evicted
+        store, cache, ssn = self._cluster(2, 2, 5, mode)
+        get_action("preempt").execute(ssn)
+        assert cache.evictor.evicts == []
+        assert ssn.jobs["c1/high"].waiting_task_num() == 0
+        close_session(ssn)
+
+
 class TestReclaim:
-    def test_cross_queue_reclaim(self):
+    def test_cross_queue_reclaim(self, mode):
         """reclaim_test.go:44-177: q2's starving high-priority job reclaims
         from q1's low-priority job. One tier [conformance, gang], victims
         come from gang's priority comparison — reclaim across equal-priority
@@ -129,7 +189,7 @@ class TestReclaim:
                               PriorityClass(name="low-priority", value=1)])
         tiers = [Tier(plugins=[PluginOption(name="conformance"),
                                PluginOption(name="gang")])]
-        ssn = open_session(cache, tiers)
+        ssn = open_mode(cache, tiers, mode)
         get_action("reclaim").execute(ssn)
         assert len(cache.evictor.evicts) == 1
         assert cache.evictor.evicts[0].startswith("c1/a")
@@ -137,7 +197,7 @@ class TestReclaim:
         assert job2.waiting_task_num() == 1
         close_session(ssn)
 
-    def test_equal_priority_no_cross_queue_reclaim(self):
+    def test_equal_priority_no_cross_queue_reclaim(self, mode):
         """With gang registered and equal job priorities, the victim
         intersection is empty and stays empty through later tiers
         (session_plugins.go:121-160 `init` persists across tiers)."""
@@ -157,12 +217,12 @@ class TestReclaim:
                                PluginOption(name="conformance")]),
                  Tier(plugins=[PluginOption(name="proportion"),
                                PluginOption(name="predicates")])]
-        ssn = open_session(cache, tiers)
+        ssn = open_mode(cache, tiers, mode)
         get_action("reclaim").execute(ssn)
         assert cache.evictor.evicts == []
         close_session(ssn)
 
-    def test_non_reclaimable_queue_protected(self):
+    def test_non_reclaimable_queue_protected(self, mode):
         queues = [build_queue("q1", weight=1, reclaimable=False),
                   build_queue("q2", weight=1)]
         pg1 = build_pod_group("pg1", "c1", min_member=1, queue="q1")
@@ -179,7 +239,7 @@ class TestReclaim:
         tiers = [Tier(plugins=[PluginOption(name="gang")]),
                  Tier(plugins=[PluginOption(name="proportion"),
                                PluginOption(name="predicates")])]
-        ssn = open_session(cache, tiers)
+        ssn = open_mode(cache, tiers, mode)
         get_action("reclaim").execute(ssn)
         assert cache.evictor.evicts == []
         close_session(ssn)

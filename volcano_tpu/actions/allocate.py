@@ -24,11 +24,37 @@ from ..api import Resource, TaskStatus
 from ..api.unschedule_info import (
     ALL_NODES_UNAVAILABLE, FitError, FitErrors, NODE_RESOURCE_FIT_FAILED,
 )
-from ..framework import Action, Arguments
+from ..framework import Action
 from ..models import PodGroupPhase
 from ..utils import PriorityQueue
 
 log = logging.getLogger(__name__)
+
+
+def build_score_inputs(ssn, arr):
+    """Resolve the session's plugin score weights against this flatten's
+    vocab/shape: (params dict for ops.score_matrix, static families tuple)."""
+    sp = ssn.score_params
+    weights_fn = ssn.solver_options.get("binpack_vocab_weights")
+    if weights_fn is not None:
+        sp.binpack_res_weights = weights_fn(arr.vocab)
+    rp = sp.resolved(arr.R, arr.N)
+    params = {
+        "binpack_weight": np.float32(rp.binpack_weight),
+        "binpack_res_weights": rp.binpack_res_weights,
+        "least_req_weight": np.float32(rp.least_req_weight),
+        "most_req_weight": np.float32(rp.most_req_weight),
+        "balanced_weight": np.float32(rp.balanced_weight),
+        "node_static": rp.node_static,
+    }
+    families = []
+    if rp.binpack_weight:
+        families.append("binpack")
+    if rp.least_req_weight or rp.most_req_weight or rp.balanced_weight:
+        families.append("kube")
+    if not families:
+        families = ["kube"]
+    return params, tuple(families)
 
 
 class AllocateAction(Action):
@@ -109,9 +135,12 @@ class AllocateAction(Action):
         from ..ops import flatten_snapshot, solve_allocate, \
             solve_allocate_sequential
 
+        host_only = ssn.solver_options.get("host_only_jobs") or ()
         job_order = []
         tasks_in_order = []
         for job in self._ordered_jobs(ssn):
+            if job.uid in host_only:
+                continue  # routed through the host loop after the solve
             tasks = self._pending_tasks(ssn, job)
             if tasks:
                 job_order.append((job, tasks))
@@ -131,39 +160,21 @@ class AllocateAction(Action):
         if use_queue_cap:
             self._fill_queue_arrays(arr, queue_opts, ssn)
 
-        sp = ssn.score_params
-        weights_fn = ssn.solver_options.get("binpack_vocab_weights")
-        if weights_fn is not None:
-            sp.binpack_res_weights = weights_fn(arr.vocab)
-        rp = sp.resolved(arr.R, arr.N)
-        params = {
-            "binpack_weight": np.float32(rp.binpack_weight),
-            "binpack_res_weights": rp.binpack_res_weights,
-            "least_req_weight": np.float32(rp.least_req_weight),
-            "most_req_weight": np.float32(rp.most_req_weight),
-            "balanced_weight": np.float32(rp.balanced_weight),
-            "node_static": rp.node_static,
-        }
-        families = []
-        if rp.binpack_weight:
-            families.append("binpack")
-        if rp.least_req_weight or rp.most_req_weight or rp.balanced_weight:
-            families.append("kube")
-        if not families:
-            families = ["kube"]
+        params, families = build_score_inputs(ssn, arr)
         herd = ssn.solver_options.get("herd_mode")
         if herd is None:
-            herd = "pack" if rp.binpack_weight > (
-                rp.least_req_weight + rp.balanced_weight) else "spread"
+            herd = "pack" if params["binpack_weight"] > (
+                params["least_req_weight"]
+                + params["balanced_weight"]) else "spread"
 
         if sequential:
             res = solve_allocate_sequential(
-                arr.device_dict(), params, score_families=tuple(families),
+                arr.device_dict(), params, score_families=families,
                 use_queue_cap=use_queue_cap)
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
-                score_families=tuple(families), use_queue_cap=use_queue_cap)
+                score_families=families, use_queue_cap=use_queue_cap)
         assigned = np.asarray(res.assigned)
         kind = np.asarray(res.kind)
 
@@ -241,16 +252,20 @@ class AllocateAction(Action):
                 FitError(task, node.name, [NODE_RESOURCE_FIT_FAILED]))
         ssn.predicate_fn(task, node)
 
-    def _execute_host(self, ssn) -> None:
+    def _execute_host(self, ssn, only_jobs=None) -> None:
         from ..plugins.predicates import PredicateError
 
         # Faithful control-flow port of allocate.go:124-265: the namespace
         # loop pops one job per iteration, requeues a ready job with
         # remaining tasks, and re-picks the queue each round so share-driven
         # orders (drf/hdrf/proportion) steer every single placement.
+        # only_jobs restricts the loop to the jobs the solver routed here
+        # (required inter-pod affinity needs in-flight placement tracking).
         namespaces = PriorityQueue(ssn.namespace_order_fn)
         jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
         for job in ssn.jobs.values():
+            if only_jobs is not None and job.uid not in only_jobs:
+                continue
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
             vr = ssn.job_valid(job)
@@ -329,15 +344,13 @@ class AllocateAction(Action):
             namespaces.push(ns)
 
     def execute(self, ssn) -> None:
-        mode = "solver"
-        for conf in ssn.configurations:
-            if conf.name == self.name():
-                mode = Arguments(conf.arguments).get("mode", "solver")
-        if ssn.solver_options.get("force_host_allocate"):
-            mode = "host"  # e.g. GPU sharing: card state is host-only
+        mode = self.resolve_mode(ssn)
         if mode == "host":
             self._execute_host(ssn)
-        elif mode == "sequential":
-            self._execute_solver(ssn, sequential=True)
-        else:
-            self._execute_solver(ssn)
+            return
+        self._execute_solver(ssn, sequential=(mode == "sequential"))
+        host_only = ssn.solver_options.get("host_only_jobs")
+        if host_only:
+            # jobs with required inter-pod affinity place via the host loop
+            # against the post-solve session state
+            self._execute_host(ssn, only_jobs=host_only)

@@ -1,0 +1,214 @@
+"""Solver-mode machinery shared by preempt and reclaim: collect claimer
+jobs and victims, flatten them, run ops.solve_evict on device, and replay
+the result through the session's Statement/evict/pipeline boundary.
+
+Mirrors the host loops' semantics (actions/preempt/preempt.go:41-262,
+actions/reclaim/reclaim.go:40-192) with the documented frozen-order
+deviations listed in ops/evict.py.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..api import TaskStatus
+from ..models import PodGroupPhase
+from ..utils import PriorityQueue
+
+log = logging.getLogger(__name__)
+
+
+def collect_claimer_jobs(ssn, require_not_pipelined: bool,
+                         skip_overused: bool) -> List[Tuple[object, List]]:
+    """(job, pending_tasks) pairs in queue -> job -> task order.
+
+    require_not_pipelined: preempt only feeds jobs that are not yet
+    JobPipelined (preempt.go:84-90); reclaim takes any starving job.
+    skip_overused: reclaim skips overused queues (reclaim.go:57-58).
+    """
+    queues_pq = PriorityQueue(ssn.queue_order_fn)
+    per_queue: Dict[str, PriorityQueue] = {}
+    for job in ssn.jobs.values():
+        if job.pod_group.status.phase == PodGroupPhase.PENDING:
+            continue
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.passed:
+            continue
+        queue = ssn.queues.get(job.queue)
+        if queue is None:
+            continue
+        pending = job.task_status_index.get(TaskStatus.PENDING, {})
+        if not any(not t.resreq.is_empty() for t in pending.values()):
+            continue
+        if require_not_pipelined and ssn.job_pipelined(job):
+            continue
+        if job.queue not in per_queue:
+            per_queue[job.queue] = PriorityQueue(ssn.job_order_fn)
+            queues_pq.push(queue)
+        per_queue[job.queue].push(job)
+
+    out = []
+    while not queues_pq.empty():
+        queue = queues_pq.pop()
+        if skip_overused and ssn.overused(queue):
+            continue
+        jobs = per_queue.get(queue.name)
+        while jobs is not None and not jobs.empty():
+            job = jobs.pop()
+            tq = PriorityQueue(ssn.task_order_fn)
+            for t in job.task_status_index.get(
+                    TaskStatus.PENDING, {}).values():
+                if not t.resreq.is_empty():
+                    tq.push(t)
+            tasks = []
+            while not tq.empty():
+                tasks.append(tq.pop())
+            if tasks:
+                out.append((job, tasks))
+    return out
+
+
+def collect_victims(ssn, nodes_list) -> List:
+    """Running, non-best-effort tasks of known jobs, grouped by node in the
+    node-index order of the flatten, cheapest-first within each node (the
+    order the host loops pop their victim priority queue,
+    preempt.go:219-228). Clones, like the host paths, so replay decisions
+    never mutate session state early."""
+    victims = []
+    for ni in nodes_list:
+        pq = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+        for t in ni.tasks.values():
+            if t.status != TaskStatus.RUNNING or t.resreq.is_empty():
+                continue
+            if t.job not in ssn.jobs:
+                continue
+            pq.push(t.clone())
+        while not pq.empty():
+            victims.append(pq.pop())
+    return victims
+
+
+def build_victim_arrays(ssn, arr, victims, job_order, mode: str) -> Dict:
+    """Victim device arrays + per-claimer-job eligibility masks.
+
+    Eligibility = queue scoping (same queue & different job for preempt;
+    other reclaimable queues for reclaim) intersected with the session's
+    tiered Preemptable/Reclaimable verdicts, evaluated once per claimer job
+    (the plugin fns read the claimer's job, not the individual task)."""
+    from ..ops.arrays import bucket
+
+    node_index = {n.name: i for i, n in enumerate(arr.nodes_list)}
+    R = arr.R
+    J = arr.job_min.shape[0]
+    V = bucket(max(len(victims), 1))
+    v_req = np.zeros((V, R), dtype=np.float32)
+    v_node = np.zeros(V, dtype=np.int32)
+    v_valid = np.zeros(V, dtype=bool)
+    for i, t in enumerate(victims):
+        v_req[i] = t.resreq.to_vector(arr.vocab)
+        v_node[i] = node_index[t.node_name]
+        v_valid[i] = True
+
+    elig = np.zeros((J, V), dtype=bool)
+    need = np.zeros(J, dtype=np.int32)
+    for j, (job, tasks) in enumerate(job_order):
+        if mode == "preempt":
+            cands = [t for t in victims
+                     if ssn.jobs[t.job].queue == job.queue
+                     and t.job != job.uid]
+            allowed = {v.uid for v in ssn.preemptable(tasks[0], cands)}
+            # pipelines still needed for JobPipelined (job_info.go:373-377)
+            need[j] = max(0, job.min_available
+                          - (job.ready_task_num() + job.waiting_task_num()))
+        else:
+            cands = []
+            for t in victims:
+                vq = ssn.queues.get(ssn.jobs[t.job].queue)
+                if (ssn.jobs[t.job].queue != job.queue
+                        and vq is not None and vq.reclaimable):
+                    cands.append(t)
+            allowed = {v.uid for v in ssn.reclaimable(tasks[0], cands)}
+            need[j] = len(tasks)  # uncapped (reclaim has no gang stop)
+        for i, t in enumerate(victims):
+            elig[j, i] = t.uid in allowed
+    return {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
+            "elig": elig, "job_need": need}
+
+
+def _evictions_by_task(evicted_by: np.ndarray) -> Dict[int, List[int]]:
+    """task index -> victim indices in victim-sorted (cheapest-first)
+    order."""
+    out: Dict[int, List[int]] = {}
+    for vi, ti in enumerate(evicted_by):
+        if ti >= 0:
+            out.setdefault(int(ti), []).append(vi)
+    return out
+
+
+def run_evict_solver(ssn, mode: str) -> bool:
+    """Flatten claimers + victims, solve on device, replay. Returns False
+    when there was nothing to do (caller may skip follow-up work)."""
+    from ..ops import flatten_snapshot
+    from ..ops.evict import solve_evict
+    from .allocate import build_score_inputs
+
+    preempt = mode == "preempt"
+    job_order = collect_claimer_jobs(
+        ssn, require_not_pipelined=preempt, skip_overused=not preempt)
+    if not job_order:
+        return False
+    tasks_in_order = [t for _, tasks in job_order for t in tasks]
+    arr = flatten_snapshot(
+        {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
+        queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None))
+    victims = collect_victims(ssn, arr.nodes_list)
+    if not victims:
+        return False
+    varrays = build_victim_arrays(ssn, arr, victims, job_order, mode)
+    params, families = build_score_inputs(ssn, arr)
+
+    res = solve_evict(
+        arr.device_dict(), {k: np.asarray(v) for k, v in varrays.items()},
+        params, score_families=families,
+        require_freed_covers=not preempt,
+        allow_revert=preempt, stop_at_need=preempt)
+    assigned = np.asarray(res.assigned)
+    evicted_by = np.asarray(res.evicted_by)
+    by_task = _evictions_by_task(evicted_by)
+
+    from ..metrics import metrics
+    idx = 0
+    for job, tasks in job_order:
+        stmt = ssn.statement() if preempt else None
+        for task in tasks:
+            t_idx = idx
+            idx += 1
+            node_idx = int(assigned[t_idx])
+            if node_idx < 0:
+                continue
+            node_name = arr.nodes_list[node_idx].name
+            try:
+                for vi in by_task.get(t_idx, ()):
+                    if preempt:
+                        stmt.evict(victims[vi], "preempt")
+                    else:
+                        ssn.evict(victims[vi], "reclaim")
+                if preempt:
+                    stmt.pipeline(task, node_name)
+                    metrics.preemption_attempts.inc()
+                else:
+                    ssn.pipeline(task, node_name)
+            except (KeyError, ValueError):
+                log.exception("%s replay failed for %s", mode, task.key)
+        if preempt:
+            metrics.preemption_victims.set(
+                sum(len(by_task.get(i, ()))
+                    for i in range(t_idx - len(tasks) + 1, t_idx + 1)))
+            if ssn.job_pipelined(job):
+                stmt.commit()
+            else:
+                stmt.discard()
+    return True

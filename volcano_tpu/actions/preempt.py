@@ -71,6 +71,47 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        if self.resolve_mode(ssn) == "host" \
+                or ssn.solver_options.get("host_only_jobs"):
+            self._execute_host(ssn)
+            return
+        from .evict_solver import run_evict_solver
+        run_evict_solver(ssn, "preempt")
+        # intra-job task-level preemption stays on the host path (small,
+        # within one job's own tasks — preempt.go:137-156 second phase)
+        self._intra_job(ssn)
+
+    def _intra_job(self, ssn) -> None:
+        for job in list(ssn.jobs.values()):
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            if job.queue not in ssn.queues:
+                continue
+            pq = PriorityQueue(ssn.task_order_fn)
+            for task in job.task_status_index.get(
+                    TaskStatus.PENDING, {}).values():
+                if not task.resreq.is_empty():
+                    pq.push(task)
+            while not pq.empty():
+                preemptor = pq.pop()
+                stmt = ssn.statement()
+
+                def task_filter(task, preemptor=preemptor):
+                    if task.status != TaskStatus.RUNNING:
+                        return False
+                    if task.resreq.is_empty():
+                        return False
+                    return preemptor.job == task.job
+
+                assigned = _preempt_one(ssn, stmt, preemptor, task_filter)
+                stmt.commit()
+                if not assigned:
+                    break
+
+    def _execute_host(self, ssn) -> None:
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request = []

@@ -24,6 +24,14 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        if self.resolve_mode(ssn) == "host" \
+                or ssn.solver_options.get("host_only_jobs"):
+            self._execute_host(ssn)
+            return
+        from .evict_solver import run_evict_solver
+        run_evict_solver(ssn, "reclaim")
+
+    def _execute_host(self, ssn) -> None:
         from ..plugins.predicates import PredicateError
 
         queues = PriorityQueue(ssn.queue_order_fn)

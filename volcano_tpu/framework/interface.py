@@ -18,6 +18,20 @@ class Action:
     def un_initialize(self) -> None:
         pass
 
+    def resolve_mode(self, ssn, default: str = "solver") -> str:
+        """Execution mode for this action: per-action YAML configuration
+        ('mode' argument), overridden to 'host' when a plugin demands
+        host-only state tracking (GPU sharing card assignment)."""
+        from .arguments import Arguments
+
+        mode = default
+        for conf in ssn.configurations:
+            if conf.name == self.name():
+                mode = Arguments(conf.arguments).get("mode", default)
+        if ssn.solver_options.get("force_host_allocate"):
+            mode = "host"
+        return mode
+
 
 class Plugin:
     def name(self) -> str:

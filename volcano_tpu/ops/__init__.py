@@ -11,13 +11,17 @@ from .arrays import (  # noqa: F401
 
 _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
          "solve_allocate_sequential", "solve_allocate_packed")
+_LAZY_EVICT = ("EvictResult", "solve_evict")
 
 __all__ = ["FlattenCache", "ScoreParams", "SnapshotArrays", "bucket",
-           "flatten_snapshot", *_LAZY]
+           "flatten_snapshot", *_LAZY, *_LAZY_EVICT]
 
 
 def __getattr__(name):
     if name in _LAZY:
         from . import solver
         return getattr(solver, name)
+    if name in _LAZY_EVICT:
+        from . import evict
+        return getattr(evict, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,173 @@
+"""Eviction solve: batched preempt/reclaim victim selection on TPU.
+
+Replaces the reference's per-preemptor Python/Go victim loops
+(actions/preempt/preempt.go:186-262, actions/reclaim/reclaim.go:40-192) with
+one jitted lax.scan over preemptor tasks:
+
+- victims are flattened once, sorted by (node, cheapest-first) — the order
+  the reference pops its per-node victim priority queue in;
+- per step, each node's minimal victim prefix that makes the preemptor fit
+  is found with segment prefix-sums ("evict cheapest-first until FutureIdle
+  fits", preempt.go:219-240 / "until the request is covered",
+  reclaim.go:91-100) — [V,R] cumsums, no host round-trips;
+- the preemptor pipelines onto the best feasible node (score order, like the
+  host loop's node_order_fn sort) and the chosen victims die for later steps;
+- preempt's gang atomicity (Statement commit iff JobPipelined) runs as a
+  job-boundary revert, exactly like solve_allocate_sequential's.
+
+Accepted greedy-order deviations vs the host oracle (documented contract):
+plugin eligibility (drf share deltas, proportion deserved) is frozen at
+solve start rather than re-evaluated after every eviction, and claimer
+queues are visited in snapshot order rather than re-sorted per placement.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .solver import NEG, _segment_prefix, le_fits, score_matrix
+
+
+class EvictResult(NamedTuple):
+    assigned: jnp.ndarray    # [T] int32: node index the task pipelines on, or -1
+    evicted_by: jnp.ndarray  # [V] int32: preemptor task index, or -1
+    job_placed: jnp.ndarray  # [J] int32: pipelined placements per job
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "score_families", "require_freed_covers", "allow_revert", "stop_at_need"))
+def solve_evict(arrays: Dict[str, jnp.ndarray],
+                victims: Dict[str, jnp.ndarray],
+                score_params: Dict[str, jnp.ndarray],
+                score_families: Tuple[str, ...] = ("kube",),
+                require_freed_covers: bool = False,
+                allow_revert: bool = True,
+                stop_at_need: bool = True) -> EvictResult:
+    """Scan preemptor tasks in (queue, job, task) rank order.
+
+    arrays: a flatten of the *pending preemptor tasks* (ops.flatten_snapshot).
+    victims: v_req [V,R] accounting resreq sorted by (node, cheapest-first);
+      v_node [V] int32; v_valid [V] bool; elig [J,V] bool per preemptor job
+      (tier-intersected Preemptable/Reclaimable verdicts + queue scoping);
+      job_need [J] int32 pipelines still needed for JobPipelined.
+
+    require_freed_covers: reclaim semantics — the freed victim resources
+      alone must cover the claimer's request (reclaim.go:91-101), vs preempt
+      where FutureIdle + freed must fit (preempt.go:219-240).
+    allow_revert / stop_at_need: preempt's gang statement semantics; off for
+      reclaim (evictions are immediate, jobs aren't capped at min).
+    """
+    a = arrays
+    v_req = victims["v_req"]
+    v_node = victims["v_node"]
+    v_valid = victims["v_valid"]
+    elig = victims["elig"]
+    need = victims["job_need"]
+    T = a["task_init_req"].shape[0]
+    N = a["node_idle"].shape[0]
+    V = v_req.shape[0]
+    thr = a["thresholds"]
+    sm = a["scalar_dim_mask"]
+    sig_feas = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
+    future0 = a["node_idle"] + a["node_extra_future"]
+    # node ordering scores, frozen at solve start: one [T,N] matmul batch
+    score_all = score_matrix(a["task_init_req"], future0, a["node_used"],
+                             a["node_alloc"], score_params, score_families)
+    seg_start = jnp.concatenate(
+        [jnp.array([True]), v_node[1:] != v_node[:-1]])
+    vidx = jnp.arange(V)
+
+    def finalize(st, jidx):
+        """Job boundary: revert this job's evictions and placements unless it
+        reached JobPipelined (Statement.Discard, preempt.go:252-257)."""
+        (future, alive, evby, assigned, jalloc,
+         s_future, s_alive, s_evby, s_assigned) = st
+        if not allow_revert:
+            return future, alive, evby, assigned, jalloc
+        done = jalloc[jidx] >= need[jidx]
+        future = jnp.where(done, future, s_future)
+        alive = jnp.where(done, alive, s_alive)
+        evby = jnp.where(done, evby, s_evby)
+        assigned = jnp.where(done, assigned, s_assigned)
+        jalloc = jnp.where(done, jalloc, jalloc.at[jidx].set(0))
+        return future, alive, evby, assigned, jalloc
+
+    def step(carry, i):
+        (future, alive, evby, assigned, jalloc, cur_job,
+         s_future, s_alive, s_evby, s_assigned) = carry
+        jidx = a["task_job"][i]
+        boundary = jidx != cur_job
+
+        def at_boundary(args):
+            future, alive, evby, assigned, jalloc = finalize(args, cur_job)
+            # fresh snapshots for the job now starting
+            return (future, alive, evby, assigned, jalloc,
+                    future, alive, evby, assigned)
+
+        (future, alive, evby, assigned, jalloc,
+         s_future, s_alive, s_evby, s_assigned) = jax.lax.cond(
+            boundary, at_boundary, lambda args: args,
+            (future, alive, evby, assigned, jalloc,
+             s_future, s_alive, s_evby, s_assigned))
+        cur_job = jidx
+
+        active = a["task_valid"][i]
+        if stop_at_need:
+            # a job stops preempting once pipelined (preempt.go:200-207)
+            active = active & (jalloc[jidx] < need[jidx])
+
+        elig_v = elig[jidx] & alive & v_valid
+        vreq_m = v_req * elig_v[:, None]
+        prefix_incl = _segment_prefix(vreq_m, seg_start) + vreq_m    # [V,R]
+        p_fit = a["task_init_req"][i][None, :]
+        if require_freed_covers:
+            fit_at = le_fits(p_fit, prefix_incl, thr, sm) & elig_v
+            fit_now = jnp.zeros(N, dtype=bool)
+        else:
+            fit_at = le_fits(p_fit, future[v_node] + prefix_incl,
+                             thr, sm) & elig_v
+            fit_now = le_fits(p_fit, future, thr, sm)
+        # minimal victim prefix per node ("cheapest-first until it fits")
+        cut = jax.ops.segment_min(jnp.where(fit_at, vidx, V), v_node,
+                                  num_segments=N)                    # [N]
+        # a node is only considered when it holds >= 1 eligible victim
+        # (validate_victims errs on an empty victim list)
+        has_v = jax.ops.segment_max(elig_v.astype(jnp.int32), v_node,
+                                    num_segments=N) > 0
+        node_ok = has_v & (fit_now | (cut < V)) & sig_feas[i] & active
+        got = jnp.any(node_ok)
+        choice = jnp.argmax(
+            jnp.where(node_ok, score_all[i], NEG)).astype(jnp.int32)
+        c = jnp.where(got, choice, 0)
+
+        ev = (elig_v & (v_node == c) & (vidx <= cut[c])
+              & got & ~fit_now[c])
+        freed = jnp.sum(v_req * ev[:, None], axis=0)
+        # evictions raise the node's future idle; the pipelined preemptor
+        # holds it back down (node_info.go:57-59 FutureIdle accounting)
+        delta = jnp.where(got, freed - a["task_req"][i], 0.0)
+        future = future.at[c].add(delta)
+        alive = alive & ~ev
+        evby = jnp.where(ev, i, evby)
+        assigned = assigned.at[i].set(jnp.where(got, choice, -1))
+        jalloc = jalloc.at[jidx].add(got.astype(jnp.int32))
+        return (future, alive, evby, assigned, jalloc, cur_job,
+                s_future, s_alive, s_evby, s_assigned), None
+
+    init_assigned = jnp.full((T,), -1, jnp.int32)
+    init_evby = jnp.full((V,), -1, jnp.int32)
+    init_jalloc = jnp.zeros(a["job_min"].shape[0], jnp.int32)
+    init = (future0, v_valid, init_evby, init_assigned, init_jalloc,
+            a["task_job"][0],
+            future0, v_valid, init_evby, init_assigned)
+    carry, _ = jax.lax.scan(step, init, jnp.arange(T))
+    (future, alive, evby, assigned, jalloc, cur_job,
+     s_future, s_alive, s_evby, s_assigned) = carry
+    future, alive, evby, assigned, jalloc = finalize(
+        (future, alive, evby, assigned, jalloc,
+         s_future, s_alive, s_evby, s_assigned), cur_job)
+    return EvictResult(assigned=assigned, evicted_by=evby, job_placed=jalloc)

@@ -86,18 +86,21 @@ class PredicatesPlugin(Plugin):
         ssn.solver_options["predicates"] = True
         # The batched kernel's feasibility masks are precomputed per node and
         # cannot see in-flight same-session placements, so required inter-pod
-        # (anti-)affinity must run the sequential host loop (the same gate the
-        # GPU-sharing predicate uses). Mirrors predicates.go:171-237
+        # (anti-)affinity must run the sequential host loop. Scoped per job
+        # (one affine pod must not downgrade the whole cluster's cycle):
+        # allocate solves the other jobs on device and routes only these
+        # through the host loop. Mirrors predicates.go:171-237
         # InterPodAffinity being a full k8s filter in the reference.
         # Only pending tasks matter: _pod_affinity_ok evaluates the incoming
         # pod's terms, never existing pods' (no anti-affinity symmetry), so a
-        # long-Running affine pod must not downgrade every cycle to host mode.
-        for job in ssn.jobs.values():
+        # long-Running affine pod must not downgrade any cycle to host mode.
+        host_only = {
+            job.uid for job in ssn.jobs.values()
             if any(_has_required_pod_affinity(t.pod)
                    for t in job.task_status_index.get(
-                       TaskStatus.PENDING, {}).values()):
-                ssn.solver_options["force_host_allocate"] = True
-                break
+                       TaskStatus.PENDING, {}).values())}
+        if host_only:
+            ssn.solver_options["host_only_jobs"] = host_only
         if self.gpu_sharing:
             # per-card feasibility depends on in-flight card assignments, so
             # the allocate pass must run the sequential host loop
