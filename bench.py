@@ -1,8 +1,26 @@
-"""Benchmark: the BASELINE.json north star.
+"""Benchmark: the BASELINE.json north star + configs #2/#4/#5.
 
-Schedules a 10k-pod / 2k-node snapshot per session on one TPU chip and
-reports p50 session latency (flatten + host->device transfer + solve +
-assignment readback) against the 50 ms target. Prints ONE JSON line.
+Headline (config #3): 10k pods / 2k nodes / 3 weighted queues, solved per
+session on one TPU chip with realistic churn between sessions (1% of jobs
+rotate out of the pending set, ~1% of node rows change), measuring:
+- p50 synchronous session latency: flatten + delta upload (device-resident
+  packed buffers, dirty chunks only) + solve + assignment readback;
+- the device-bound solve rate (back-to-back solves on device-resident
+  buffers): the throughput a locally attached chip sustains;
+- the backend's no-op dispatch RTT floor. On a tunneled device (axon) the
+  sync p50 is wire-dominated; p50 - RTT is the implementation's share.
+  (Overlapped readback was measured and is a net LOSS on this tunnel —
+  queued transfers degrade it — so sessions are timed synchronously.)
+
+Also measured, reported in extra.configs:
+- #2  500 pods / 50 nodes: rounds-solver vs sequential-reference parity
+      (identical job_ready sets + per-node capacity respect) + solve time.
+- #4  2k running pods / 1k-pod high-priority gang: batched eviction solve
+      (ops.solve_evict) end-to-end time.
+- #5  5k pods / 1k nodes / 4 hierarchical-weight queues, cpu+mem+gpu
+      multi-resource binpack with in-kernel queue caps.
+
+Prints ONE JSON line.
 """
 
 from __future__ import annotations
@@ -10,52 +28,182 @@ from __future__ import annotations
 import json
 import sys
 import time
+from types import SimpleNamespace
 
 import numpy as np
 
 TARGET_MS = 50.0
-N_NODES = 2000
-N_JOBS = 1000
-TASKS_PER_JOB = 10
-SESSIONS = 10
+SESSIONS = 8
+CHURN_JOBS = 10       # jobs rotated out of the pending set per session
+CHURN_NODES = 20      # node rows dirtied per session
 
 
-def main() -> int:
-    t_setup = time.time()
+def make_problem(n_nodes, n_jobs, tasks_per_job, cpu="32", mem="128Gi",
+                 n_queues=1, queue_weights=None, gpu_every=0):
+    from volcano_tpu.api import JobInfo, NodeInfo, TaskInfo
+    from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+    from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
+
+    nodes = {}
+    for i in range(n_nodes):
+        rl = {"cpu": cpu, "memory": mem, "pods": 110}
+        if gpu_every:
+            rl["nvidia.com/gpu"] = 8
+        nodes[f"n{i}"] = NodeInfo(Node(name=f"n{i}", allocatable=rl,
+                                       capacity=dict(rl)))
+    jobs, tasks = {}, []
+    for k in range(n_jobs):
+        queue = f"q{k % n_queues}"
+        pg = PodGroup(name=f"j{k}", namespace="bench",
+                      spec=PodGroupSpec(min_member=tasks_per_job,
+                                        queue=queue))
+        job = JobInfo(f"bench/j{k}", pg)
+        for i in range(tasks_per_job):
+            # sizes vary by job so churn dirties real content (uniform
+            # sizes make rotated jobs' rows byte-identical)
+            req = {"cpu": str(1 + k % 3), "memory": f"{1 + k % 4}Gi"}
+            if gpu_every and k % gpu_every == 0:
+                req["nvidia.com/gpu"] = 1
+            pod = Pod(name=f"j{k}-{i}", namespace="bench",
+                      annotations={POD_GROUP_ANNOTATION: f"j{k}"},
+                      containers=[{"requests": req}])
+            t = TaskInfo(pod)
+            job.add_task_info(t)
+            tasks.append(t)
+        jobs[job.uid] = job
+    weights = queue_weights or [1] * n_queues
+    queues = {f"q{i}": SimpleNamespace(weight=weights[i], capability=None)
+              for i in range(n_queues)}
+    return jobs, nodes, tasks, queues
+
+
+_demand_cache = {}
+
+
+def fill_queue_demand(arr, jobs):
+    """Bench stand-in for the proportion plugin's session-open attrs:
+    request = total demand per queue, allocated = 0. Per-job demand vectors
+    cache on (uid, flat_version) like the flatten's blocks."""
+    qidx = {q: i for i, q in enumerate(arr.queues_list)}
+    arr.queue_request[:] = 0.0
+    arr.queue_allocated[:] = 0.0
+    for job in jobs.values():
+        i = qidx.get(job.queue)
+        if i is None:
+            continue
+        ent = _demand_cache.get(job.uid)
+        if ent is None or ent[0] != job.flat_version \
+                or ent[1].shape[0] != arr.R:
+            ent = (job.flat_version,
+                   job.total_request.to_vector(arr.vocab))
+            _demand_cache[job.uid] = ent
+        arr.queue_request[i] += ent[1]
+
+
+def headline():
     import jax
-    from __graft_entry__ import _make_problem, _params
-    from volcano_tpu.ops import FlattenCache, flatten_snapshot
-    from volcano_tpu.ops.solver import solve_allocate_packed
+    from __graft_entry__ import _params
+    from volcano_tpu.api import TaskStatus
+    from volcano_tpu.ops import FlattenCache, PackedDeviceCache, \
+        flatten_snapshot
+    from volcano_tpu.ops.solver import solve_allocate_packed2d
 
-    jobs, nodes, tasks = _make_problem(
-        n_nodes=N_NODES, n_jobs=N_JOBS, tasks_per_job=TASKS_PER_JOB,
-        cpu="32", mem="128Gi")
+    n_nodes, n_jobs, tpj = 2000, 1000, 10
+    jobs, nodes, tasks, queues = make_problem(
+        n_nodes, n_jobs, tpj, n_queues=3, queue_weights=[1, 2, 3])
+    node_list = list(nodes.values())
+    fcache, dcache = FlattenCache(), PackedDeviceCache()
 
-    # warmup: flatten + compile once (compile time excluded from sessions,
-    # like any steady-state scheduler: buckets are stable across cycles and
-    # the SchedulerCache keeps its FlattenCache warm between sessions)
-    fcache = FlattenCache()
-    arr = flatten_snapshot(jobs, nodes, tasks, cache=fcache)
-    fbuf, ibuf, layout = arr.packed()
-    params = _params(arr)
-    res = solve_allocate_packed(fbuf, ibuf, layout, params)
-    res.assigned.block_until_ready()
-    setup_s = time.time() - t_setup
+    held = {}
 
-    lat_ms = []
-    placed = 0
-    for _ in range(SESSIONS):
-        t0 = time.perf_counter()
-        arr = flatten_snapshot(jobs, nodes, tasks, cache=fcache)
+    def churn(s):
+        """Rotate CHURN_JOBS jobs out of the pending set and dirty
+        CHURN_NODES node rows through the accounting API."""
+        from volcano_tpu.api import TaskInfo
+        from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+        from volcano_tpu.models import Pod
+
+        lo = (s * CHURN_JOBS) % n_jobs
+        excl = {f"bench/j{(lo + d) % n_jobs}" for d in range(CHURN_JOBS)}
+        jobs_s = {u: j for u, j in jobs.items() if u not in excl}
+        tasks_s = [t for t in tasks if t.job not in excl]
+        for d in range(CHURN_NODES):
+            ni = node_list[(s * CHURN_NODES + d) % n_nodes]
+            t = held.pop(ni.name, None)
+            if t is not None:
+                ni.remove_task(t)
+            else:
+                pod = Pod(name=f"churn-{ni.name}", namespace="bench",
+                          node_name=ni.name, phase="Running",
+                          annotations={POD_GROUP_ANNOTATION: "j0"},
+                          containers=[{"requests": {"cpu": "1",
+                                                    "memory": "1Gi"}}])
+                t = TaskInfo(pod)
+                t.status = TaskStatus.RUNNING
+                ni.add_task(t)
+                held[ni.name] = t
+        return jobs_s, tasks_s
+
+    def one_session(jobs_s, tasks_s):
+        arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
+                               queues=queues)
+        fill_queue_demand(arr, jobs_s)
         fbuf, ibuf, layout = arr.packed()
-        res = solve_allocate_packed(fbuf, ibuf, layout, params)
-        assigned = np.asarray(res.assigned)  # readback
-        lat_ms.append((time.perf_counter() - t0) * 1e3)
-        placed = int((assigned[:len(tasks)] >= 0).sum())
+        f2d, i2d = dcache.update(fbuf, ibuf, layout)
+        params = _params(arr)
+        return solve_allocate_packed2d(f2d, i2d, layout, params,
+                                       use_queue_cap=True)
 
-    # dispatch/readback floor of this JAX backend: a no-op jit roundtrip.
-    # On a tunneled device (axon) this is pure network RTT that no scheduler
-    # implementation can beat; on a locally attached TPU it is ~0.
+    # warmup / compile, on the same churn pattern the timed sessions use so
+    # the delta-scatter kernels for steady-state chunk-count buckets are
+    # already compiled (a fresh bucket recompiles ~1s)
+    for s in range(4):
+        res = one_session(*churn(s))
+    res.assigned.block_until_ready()
+
+    # synchronous sessions (the honest per-cycle latency)
+    lat, flat_ms, chunks, placed = [], [], [], 0
+    for s in range(4, 4 + SESSIONS):
+        jobs_s, tasks_s = churn(s)
+        t0 = time.perf_counter()
+        res = one_session(jobs_s, tasks_s)
+        assigned = np.asarray(res.compact)
+        lat.append((time.perf_counter() - t0) * 1e3)
+        chunks.append(dcache.last_shipped_chunks)
+        placed = int((assigned[:len(tasks_s)] >= 0).sum())
+    # flatten-only share (warm, with churn)
+    jobs_s, tasks_s = churn(4 + SESSIONS)
+    t0 = time.perf_counter()
+    arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
+                           queues=queues)
+    fill_queue_demand(arr, jobs_s)
+    arr.packed()
+    flatten_ms = (time.perf_counter() - t0) * 1e3
+
+    # device-bound solve rate: back-to-back solves on device-resident
+    # buffers — the throughput a locally-attached chip sustains, without
+    # this dev environment's ~100 ms tunnel RTT / ~5 MB/s wire in the loop
+    jobs_s, tasks_s = churn(6 + 3 * SESSIONS)
+    r = one_session(jobs_s, tasks_s)
+    r.compact.block_until_ready()
+    arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
+                           queues=queues)
+    fill_queue_demand(arr, jobs_s)
+    fbuf, ibuf, layout = arr.packed()
+    f2d, i2d = dcache.update(fbuf, ibuf, layout)
+    params = _params(arr)
+    t0 = time.perf_counter()
+    dev_futs = [solve_allocate_packed2d(f2d, i2d, layout, params,
+                                        use_queue_cap=True)
+                for _ in range(SESSIONS)]
+    # device work is serial in dispatch order: blocking on the last result
+    # times all SESSIONS solves with a single amortized round trip
+    dev_futs[-1].compact.block_until_ready()
+    dev_dt = time.perf_counter() - t0
+    device_ms = dev_dt / SESSIONS * 1e3
+    device_pods_per_sec = int(len(tasks_s) * SESSIONS / dev_dt)
+
+    # backend no-op dispatch floor (pure wire RTT on a tunneled device)
     noop = jax.jit(lambda x: x + 1)
     np.asarray(noop(np.zeros(8, np.float32)))
     floors = []
@@ -63,32 +211,207 @@ def main() -> int:
         t0 = time.perf_counter()
         np.asarray(noop(np.zeros(8, np.float32)))
         floors.append((time.perf_counter() - t0) * 1e3)
-    rtt_floor = float(np.percentile(floors, 50))
+    rtt = float(np.percentile(floors, 50))
 
-    # host-side flatten share of a session (incremental, warm cache)
+    p50 = float(np.percentile(lat, 50))
+    return {
+        "p50_ms": round(p50, 2),
+        "p90_ms": round(float(np.percentile(lat, 90)), 2),
+        "rtt_floor_ms": round(rtt, 2),
+        "p50_minus_rtt_ms": round(max(p50 - rtt, 0.0), 2),
+        "pods_per_sec": int(placed / (p50 / 1e3)),
+        "device_ms_per_session": round(device_ms, 2),
+        "device_pods_per_sec": device_pods_per_sec,
+        "flatten_ms": round(flatten_ms, 2),
+        "shipped_chunks_mean": round(float(np.mean(chunks)), 1),
+        "placed": placed,
+        "sessions": SESSIONS,
+    }
+
+
+def config2_parity():
+    """500 pods / 50 nodes: rounds solver vs sequential reference greedy."""
+    from __graft_entry__ import _params
+    from volcano_tpu.ops import flatten_snapshot
+    from volcano_tpu.ops.solver import solve_allocate, \
+        solve_allocate_sequential
+
+    import jax
+
+    jobs, nodes, tasks, _ = make_problem(50, 100, 5, cpu="16", mem="64Gi")
+    arr = flatten_snapshot(jobs, nodes, tasks)
+    params = _params(arr)
+    d = {k: jax.device_put(v) for k, v in arr.device_dict().items()}
+    r1 = solve_allocate(d, params)
+    r2 = solve_allocate_sequential(d, params)
+    ready1 = np.asarray(r1.job_ready)
+    ready2 = np.asarray(r2.job_ready)
     t0 = time.perf_counter()
-    flatten_snapshot(jobs, nodes, tasks, cache=fcache).packed()
-    flatten_ms = (time.perf_counter() - t0) * 1e3
+    np.asarray(solve_allocate(d, params).compact)
+    solve_ms = (time.perf_counter() - t0) * 1e3
+    # capacity respect for the rounds solver
+    a = np.asarray(r1.assigned)
+    k = np.asarray(r1.kind)
+    used = np.zeros_like(arr.node_idle)
+    for i in np.nonzero((a >= 0) & (k == 0))[0]:
+        used[a[i]] += arr.task_req[i]
+    cap_ok = bool((used <= arr.node_idle + 1e-3).all())
+    return {
+        "tasks": len(tasks), "nodes": 50,
+        # under contention the rounds solver and the sequential reference
+        # can satisfy different (equally valid) job subsets; report both
+        # the overlap and the work each completes
+        "job_ready_agreement": round(
+            float((ready1 == ready2).mean()), 4),
+        "jobs_ready_rounds": int(ready1.sum()),
+        "jobs_ready_sequential": int(ready2.sum()),
+        "placed_rounds": int((a >= 0).sum()),
+        "placed_sequential": int((np.asarray(r2.assigned) >= 0).sum()),
+        "capacity_respected": cap_ok,
+        "solve_ms": round(solve_ms, 2),
+    }
 
-    p50 = float(np.percentile(lat_ms, 50))
-    p90 = float(np.percentile(lat_ms, 90))
-    pods_per_sec = len(tasks) / (p50 / 1e3)
+
+def config4_preempt():
+    """2k running pods; a 1k-task high-priority gang triggers the batched
+    eviction solve (ops.solve_evict)."""
+    from __graft_entry__ import _params
+    from volcano_tpu.api import JobInfo, NodeInfo, TaskInfo, TaskStatus
+    from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+    from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
+    from volcano_tpu.ops import bucket, flatten_snapshot
+    from volcano_tpu.ops.evict import solve_evict
+
+    n_nodes, n_running, n_claim = 200, 2000, 1000
+    nodes = {}
+    for i in range(n_nodes):
+        rl = {"cpu": "16", "memory": "64Gi", "pods": 110}
+        nodes[f"n{i}"] = NodeInfo(Node(name=f"n{i}", allocatable=rl,
+                                       capacity=dict(rl)))
+    low_pg = PodGroup(name="low", namespace="bench",
+                      spec=PodGroupSpec(min_member=1))
+    low = JobInfo("bench/low", low_pg)
+    victims = []
+    for i in range(n_running):
+        pod = Pod(name=f"low-{i}", namespace="bench",
+                  node_name=f"n{i % n_nodes}", phase="Running",
+                  annotations={POD_GROUP_ANNOTATION: "low"},
+                  containers=[{"requests": {"cpu": "1", "memory": "2Gi"}}])
+        t = TaskInfo(pod)
+        t.status = TaskStatus.RUNNING
+        low.add_task_info(t)
+        nodes[f"n{i % n_nodes}"].add_task(t)
+        victims.append(t)
+    hi_pg = PodGroup(name="hi", namespace="bench",
+                     spec=PodGroupSpec(min_member=n_claim))
+    hi = JobInfo("bench/hi", hi_pg)
+    claimers = []
+    for i in range(n_claim):
+        pod = Pod(name=f"hi-{i}", namespace="bench",
+                  annotations={POD_GROUP_ANNOTATION: "hi"},
+                  containers=[{"requests": {"cpu": "2", "memory": "4Gi"}}])
+        t = TaskInfo(pod)
+        hi.add_task_info(t)
+        claimers.append(t)
+
+    arr = flatten_snapshot({hi.uid: hi}, nodes, claimers)
+    params = _params(arr)
+    node_index = {n.name: i for i, n in enumerate(arr.nodes_list)}
+    ordered = sorted(victims, key=lambda t: node_index[t.node_name])
+    V = bucket(len(ordered))
+    R = arr.R
+    J = arr.job_min.shape[0]
+    v_req = np.zeros((V, R), np.float32)
+    v_node = np.zeros(V, np.int32)
+    v_valid = np.zeros(V, bool)
+    for i, t in enumerate(ordered):
+        v_req[i] = t.resreq.to_vector(arr.vocab)
+        v_node[i] = node_index[t.node_name]
+        v_valid[i] = True
+    elig = np.zeros((J, V), bool)
+    elig[0, :len(ordered)] = True  # priority tier: all lower-prio victims
+    need = np.zeros(J, np.int32)
+    need[0] = n_claim
+    varrays = {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
+               "elig": elig, "job_need": need}
+
+    import jax
+
+    d = {k: jax.device_put(v) for k, v in arr.device_dict().items()}
+    v = {k: jax.device_put(np.asarray(val)) for k, val in varrays.items()}
+    res = solve_evict(d, v, params)  # compile
+    res.assigned.block_until_ready()
+    t0 = time.perf_counter()
+    res = solve_evict(d, v, params)
+    assigned = np.asarray(res.assigned)
+    evicted = np.asarray(res.evicted_by)
+    dt = (time.perf_counter() - t0) * 1e3
+    return {
+        "running": n_running, "claimers": n_claim, "nodes": n_nodes,
+        "solve_ms": round(dt, 2),
+        "placed": int((assigned[:n_claim] >= 0).sum()),
+        "evictions": int((evicted >= 0).sum()),
+    }
+
+
+def config5_hierarchical():
+    """5k pods / 1k nodes / 4 weighted queues, cpu+mem+gpu binpack with
+    in-kernel queue caps."""
+    from __graft_entry__ import _params
+    from volcano_tpu.ops import FlattenCache, PackedDeviceCache, \
+        flatten_snapshot
+    from volcano_tpu.ops.solver import solve_allocate_packed2d
+
+    jobs, nodes, tasks, queues = make_problem(
+        1000, 500, 10, cpu="16", mem="64Gi",
+        n_queues=4, queue_weights=[1, 2, 3, 4], gpu_every=5)
+    fcache, dcache = FlattenCache(), PackedDeviceCache()
+    arr = flatten_snapshot(jobs, nodes, tasks, cache=fcache, queues=queues)
+    fill_queue_demand(arr, jobs)
+    fbuf, ibuf, layout = arr.packed()
+    f2d, i2d = dcache.update(fbuf, ibuf, layout)
+    params = _params(arr)
+    res = solve_allocate_packed2d(f2d, i2d, layout, params,
+                                  use_queue_cap=True)
+    res.assigned.block_until_ready()
+    t0 = time.perf_counter()
+    arr = flatten_snapshot(jobs, nodes, tasks, cache=fcache, queues=queues)
+    fill_queue_demand(arr, jobs)
+    fbuf, ibuf, layout = arr.packed()
+    f2d, i2d = dcache.update(fbuf, ibuf, layout)
+    res = solve_allocate_packed2d(f2d, i2d, layout, params,
+                                  use_queue_cap=True)
+    assigned = np.asarray(res.assigned)
+    dt = (time.perf_counter() - t0) * 1e3
+    return {
+        "tasks": len(tasks), "nodes": 1000, "queues": 4,
+        "session_ms": round(dt, 2),
+        "placed": int((assigned[:len(tasks)] >= 0).sum()),
+    }
+
+
+def main() -> int:
+    t_setup = time.time()
+    import jax
+
+    h = headline()
+    configs = {
+        "config2_parity_500x50": config2_parity(),
+        "config4_preempt_2k_1k": config4_preempt(),
+        "config5_hier_5k_1k": config5_hierarchical(),
+    }
+    setup_s = time.time() - t_setup
+
+    p50 = h.pop("p50_ms")
     result = {
         "metric": "p50 session latency @10k pods/2k nodes",
-        "value": round(p50, 2),
+        "value": p50,
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p50, 2),
         "extra": {
-            "p90_ms": round(p90, 2),
-            "pods_per_sec": int(pods_per_sec),
-            "placed": placed,
-            "tasks": len(tasks),
-            "nodes": N_NODES,
-            "sessions": SESSIONS,
+            **h,
+            "configs": configs,
             "setup_s": round(setup_s, 1),
-            "rtt_floor_ms": round(rtt_floor, 2),
-            "p50_minus_rtt_ms": round(max(p50 - rtt_floor, 0.0), 2),
-            "flatten_ms": round(flatten_ms, 2),
             "device": str(jax.devices()[0]),
         },
     }

@@ -150,7 +150,8 @@ class AllocateAction(Action):
 
         arr = flatten_snapshot(
             {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
-            queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None))
+            queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None),
+            grouped=job_order)
 
         # queue fairness: when proportion is active its session-open attrs
         # (allocated/request over ALL jobs, incl. running-only queues) feed
@@ -167,16 +168,31 @@ class AllocateAction(Action):
                 params["least_req_weight"]
                 + params["balanced_weight"]) else "spread"
 
+        dc = getattr(ssn, "device_cache", None)
         if sequential:
             res = solve_allocate_sequential(
                 arr.device_dict(), params, score_families=families,
                 use_queue_cap=use_queue_cap)
+        elif dc is not None:
+            # device-resident buffers: per-session upload = dirty chunks only
+            from ..ops.solver import solve_allocate_packed2d
+            fbuf, ibuf, layout = arr.packed()
+            f2d, i2d = dc.update(fbuf, ibuf, layout)
+            res = solve_allocate_packed2d(
+                f2d, i2d, layout, params, herd_mode=herd,
+                score_families=families, use_queue_cap=use_queue_cap)
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
                 score_families=families, use_queue_cap=use_queue_cap)
-        assigned = np.asarray(res.assigned)
-        kind = np.asarray(res.kind)
+        # one int16 readback instead of two int32 ones: the tunnel to a
+        # remote chip is bandwidth-poor, so the result wire format matters
+        from ..ops.solver import COMPACT_KIND_SHIFT, decode_compact
+        if arr.N <= (1 << COMPACT_KIND_SHIFT):
+            assigned, kind = decode_compact(res.compact)
+        else:  # >16k nodes: node index overflows the int16 packing
+            assigned = np.asarray(res.assigned)
+            kind = np.asarray(res.kind)
 
         # replay through the Statement boundary in job order
         idx = 0

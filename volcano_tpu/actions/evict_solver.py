@@ -148,9 +148,11 @@ def _evictions_by_task(evicted_by: np.ndarray) -> Dict[int, List[int]]:
     return out
 
 
-def run_evict_solver(ssn, mode: str) -> bool:
-    """Flatten claimers + victims, solve on device, replay. Returns False
-    when there was nothing to do (caller may skip follow-up work)."""
+def run_evict_solver(ssn, mode: str):
+    """Flatten claimers + victims, solve on device, replay. Returns the
+    claimer jobs processed (the host loops' under_request set — preempt's
+    intra-job phase must run on exactly these), or [] when there was
+    nothing to do."""
     from ..ops import flatten_snapshot
     from ..ops.evict import solve_evict
     from .allocate import build_score_inputs
@@ -159,14 +161,15 @@ def run_evict_solver(ssn, mode: str) -> bool:
     job_order = collect_claimer_jobs(
         ssn, require_not_pipelined=preempt, skip_overused=not preempt)
     if not job_order:
-        return False
+        return []
     tasks_in_order = [t for _, tasks in job_order for t in tasks]
     arr = flatten_snapshot(
         {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
-        queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None))
+        queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None),
+        grouped=job_order)
     victims = collect_victims(ssn, arr.nodes_list)
     if not victims:
-        return False
+        return [j for j, _ in job_order]
     varrays = build_victim_arrays(ssn, arr, victims, job_order, mode)
     params, families = build_score_inputs(ssn, arr)
 
@@ -211,4 +214,4 @@ def run_evict_solver(ssn, mode: str) -> bool:
                 stmt.commit()
             else:
                 stmt.discard()
-    return True
+    return [j for j, _ in job_order]

@@ -76,20 +76,15 @@ class PreemptAction(Action):
             self._execute_host(ssn)
             return
         from .evict_solver import run_evict_solver
-        run_evict_solver(ssn, "preempt")
+        claimers = run_evict_solver(ssn, "preempt")
         # intra-job task-level preemption stays on the host path (small,
-        # within one job's own tasks — preempt.go:137-156 second phase)
-        self._intra_job(ssn)
+        # within one job's own tasks — preempt.go:137-156 second phase).
+        # It runs on exactly the solver's claimer set (the host loop's
+        # under_request: jobs that were not yet pipelined at collection).
+        self._intra_job(ssn, claimers)
 
-    def _intra_job(self, ssn) -> None:
-        for job in list(ssn.jobs.values()):
-            if job.pod_group.status.phase == PodGroupPhase.PENDING:
-                continue
-            vr = ssn.job_valid(job)
-            if vr is not None and not vr.passed:
-                continue
-            if job.queue not in ssn.queues:
-                continue
+    def _intra_job(self, ssn, jobs) -> None:
+        for job in jobs:
             pq = PriorityQueue(ssn.task_order_fn)
             for task in job.task_status_index.get(
                     TaskStatus.PENDING, {}).values():

@@ -122,7 +122,10 @@ class SchedulerCache:
         # incremental snapshot-flatten state shared across sessions
         # (ops.arrays.FlattenCache; versions on JobInfo/NodeInfo invalidate)
         from ..ops.arrays import FlattenCache
+        from ..ops.device_cache import PackedDeviceCache
         self.flatten_cache = FlattenCache()
+        # device-resident packed solver buffers (delta-shipped per session)
+        self.device_cache = PackedDeviceCache()
 
         self._create_default_queue()
 

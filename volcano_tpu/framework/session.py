@@ -91,6 +91,7 @@ class Session:
         self.score_params = ScoreParams()
         self.solver_options: Dict[str, object] = {}
         self.flatten_cache = getattr(cache, "flatten_cache", None)
+        self.device_cache = getattr(cache, "device_cache", None)
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)

@@ -10,11 +10,13 @@ from .arrays import (  # noqa: F401
 )
 
 _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
-         "solve_allocate_sequential", "solve_allocate_packed")
+         "solve_allocate_sequential", "solve_allocate_packed",
+         "solve_allocate_packed2d")
 _LAZY_EVICT = ("EvictResult", "solve_evict")
+_LAZY_DEVCACHE = ("PackedDeviceCache",)
 
 __all__ = ["FlattenCache", "ScoreParams", "SnapshotArrays", "bucket",
-           "flatten_snapshot", *_LAZY, *_LAZY_EVICT]
+           "flatten_snapshot", *_LAZY, *_LAZY_EVICT, *_LAZY_DEVCACHE]
 
 
 def __getattr__(name):
@@ -24,4 +26,7 @@ def __getattr__(name):
     if name in _LAZY_EVICT:
         from . import evict
         return getattr(evict, name)
+    if name in _LAZY_DEVCACHE:
+        from . import device_cache
+        return getattr(device_cache, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,106 @@
+"""Device-resident packed solver buffers with chunked delta upload.
+
+The tunnel to a remote TPU is latency- and bandwidth-expensive: re-shipping
+the full packed snapshot (~0.5 MB at 10k tasks / 2k nodes) every session
+costs ~100 ms, while the cluster typically changes a few rows per cycle.
+This cache keeps the two packed buffers (ops.arrays.SnapshotArrays.packed)
+resident on device and ships only the chunks whose bytes changed since the
+previous session, applied with a donated in-place scatter — the TPU-native
+analog of the reference's informer deltas (client-go list-watch keeps the
+scheduler's mirror warm instead of re-listing the cluster,
+pkg/scheduler/cache/cache.go:319-402).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _pow2_bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+_APPLY = None  # lazily created singleton so the jit caches across sessions
+
+
+def _scatter(dev, idx, vals):
+    global _APPLY
+    if _APPLY is None:
+        import jax
+        _APPLY = jax.jit(lambda d, i, v: d.at[i].set(v), donate_argnums=(0,))
+    return _APPLY(dev, idx, vals)
+
+
+class PackedDeviceCache:
+    """update(fbuf, ibuf, layout) -> (f2d, i2d) device arrays [C, chunk].
+
+    First call (or any layout/shape change) ships everything; later calls
+    diff against the previously shipped host copy chunk-wise and scatter
+    only dirty chunks. Chunk-index uploads are bucketed to powers of two so
+    the scatter kernel compiles a handful of times, not per session.
+    """
+
+    def __init__(self, chunk: int = 512):
+        self.chunk = chunk
+        self._host_f: Optional[np.ndarray] = None  # padded copy, [Cf*chunk]
+        self._host_i: Optional[np.ndarray] = None
+        self._dev_f = None                         # [Cf, chunk] on device
+        self._dev_i = None
+        self._layout = None
+        self.last_shipped_chunks = 0               # diagnostics
+
+    def reset(self) -> None:
+        self._host_f = self._host_i = None
+        self._dev_f = self._dev_i = None
+        self._layout = None
+
+    def update(self, fbuf: np.ndarray, ibuf: np.ndarray,
+               layout) -> Tuple[object, object]:
+        import jax
+
+        c = self.chunk
+        cf = -(-max(fbuf.size, 1) // c)
+        ci = -(-max(ibuf.size, 1) // c)
+        if (self._layout != layout or self._host_f is None
+                or self._host_f.size != cf * c
+                or self._host_i.size != ci * c):
+            hf = np.zeros(cf * c, np.float32)
+            hf[:fbuf.size] = fbuf
+            hi = np.zeros(ci * c, np.int32)
+            hi[:ibuf.size] = ibuf
+            self._host_f, self._host_i = hf, hi
+            self._dev_f = jax.device_put(hf.reshape(cf, c))
+            self._dev_i = jax.device_put(hi.reshape(ci, c))
+            self._layout = layout
+            self.last_shipped_chunks = cf + ci
+            return self._dev_f, self._dev_i
+
+        f2 = np.zeros_like(self._host_f)
+        f2[:fbuf.size] = fbuf
+        i2 = np.zeros_like(self._host_i)
+        i2[:ibuf.size] = ibuf
+        df = np.nonzero((f2.reshape(cf, c)
+                         != self._host_f.reshape(cf, c)).any(axis=1))[0]
+        di = np.nonzero((i2.reshape(ci, c)
+                         != self._host_i.reshape(ci, c)).any(axis=1))[0]
+        self._host_f, self._host_i = f2, i2
+        self.last_shipped_chunks = int(df.size + di.size)
+        self._dev_f = self._apply(self._dev_f, df, f2.reshape(cf, c))
+        self._dev_i = self._apply(self._dev_i, di, i2.reshape(ci, c))
+        return self._dev_f, self._dev_i
+
+    @staticmethod
+    def _apply(dev, idx, host2d):
+        if idx.size == 0:
+            return dev
+        k = _pow2_bucket(idx.size)
+        # pad with repeats of the first dirty chunk: duplicate scatter
+        # indices write the same value, so the pad is a no-op
+        pad = np.full(k, idx[0], np.int32)
+        pad[:idx.size] = idx.astype(np.int32)
+        return _scatter(dev, pad, host2d[pad])

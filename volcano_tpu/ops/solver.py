@@ -47,6 +47,39 @@ class SolveResult(NamedTuple):
     kind: jnp.ndarray       # [T] int32: 0 = allocate, 1 = pipeline, -1 = none
     job_ready: jnp.ndarray  # [J] bool: job committed (gang-satisfied)
     rounds: jnp.ndarray     # [] int32 diagnostic
+    compact: jnp.ndarray = None  # [T] int16: node | (kind << 14), -1 = none
+                                 # — the wire-cheap readback (decode with
+                                 # decode_compact); assigned/kind stay for
+                                 # in-kernel consumers and tests
+
+
+COMPACT_KIND_SHIFT = 14        # node index < 2^14; kind bit above it
+COMPACT_UNAVAILABLE = -2       # whole-array sentinel: N too large to pack
+
+
+def _compact(assigned, kind, n_nodes: int):
+    if n_nodes > (1 << COMPACT_KIND_SHIFT):
+        # node indices don't fit 14 bits: emit a detectable sentinel so a
+        # consumer that forgets the N guard fails loudly in decode_compact
+        # instead of silently mis-decoding wrapped values
+        return jnp.full(assigned.shape, COMPACT_UNAVAILABLE, jnp.int16)
+    return jnp.where(
+        assigned < 0, jnp.int16(-1),
+        (assigned + kind * (1 << COMPACT_KIND_SHIFT)).astype(jnp.int16))
+
+
+def decode_compact(compact):
+    """host-side: compact int16 -> (assigned int32, kind int32)."""
+    import numpy as np
+    c = np.asarray(compact).astype(np.int32)
+    if c.size and c[0] == COMPACT_UNAVAILABLE:
+        raise ValueError(
+            "compact result unavailable (node count exceeds the int16 "
+            "packing); read res.assigned / res.kind instead")
+    none = c < 0
+    kind = np.where(none, -1, c >> COMPACT_KIND_SHIFT)
+    assigned = np.where(none, -1, c & ((1 << COMPACT_KIND_SHIFT) - 1))
+    return assigned, kind
 
 
 # ---------------------------------------------------------------------------
@@ -157,24 +190,28 @@ def water_fill_deserved(total, weight, cap, request, thr, max_iters: int):
     return deserved
 
 
-def _queue_cap_mask(eligible, task_queue, req, qrem, rank, thr, scalar_mask):
-    """Per-round queue admission cap: among eligible tasks sorted by
-    (queue, rank), a task passes iff its queue's running prefix + its own
-    request still fits the queue's remaining deserved (threshold-tolerant,
-    like fits_matrix). Conservative like node prefix admission: a blocked
-    task waits for the next round's recomputed remaining."""
+def _queue_cap_mask(eligible, task_queue, req, qrem, thr, scalar_mask,
+                    q_perm, q_seg_start):
+    """Per-round queue admission cap: among eligible tasks in (queue, rank)
+    order, a task passes iff its queue's running prefix of *eligible*
+    requests + its own request still fits the queue's remaining deserved
+    (threshold-tolerant, like fits_matrix). Conservative like node prefix
+    admission: a blocked task waits for the next round's recomputed
+    remaining.
+
+    q_perm/q_seg_start are the static (queue, rank) sort and its queue
+    segment boundaries — task_queue and rank never change within a solve,
+    so the sort is hoisted out of the round loop (one argsort per solve
+    instead of one per round); only the eligibility mask varies here."""
     T = req.shape[0]
-    key = jnp.where(eligible, task_queue * (T + 1) + rank, BIG_KEY)
-    perm = jnp.argsort(key)
-    s_q = task_queue[perm]
-    s_act = eligible[perm]
-    s_req = req[perm] * s_act[:, None]
-    seg_start = jnp.concatenate([jnp.array([True]), s_q[1:] != s_q[:-1]])
-    prefix = _segment_prefix(s_req, seg_start)
+    s_q = task_queue[q_perm]
+    s_act = eligible[q_perm]
+    s_req = req[q_perm] * s_act[:, None]
+    prefix = _segment_prefix(s_req, q_seg_start)
     s_rem = qrem[s_q]
     ok_sorted = le_fits(prefix + s_req, s_rem, thr, scalar_mask,
                         ignore_req=s_req) & s_act
-    return jnp.zeros(T, dtype=bool).at[perm].set(ok_sorted)
+    return jnp.zeros(T, dtype=bool).at[q_perm].set(ok_sorted)
 
 
 def _segment_prefix(sorted_vals, seg_start_mask):
@@ -336,9 +373,15 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             a["queue_request"], thr, max_iters=Q + 1)
         task_queue = a["job_queue"][a["task_job"]]
         qalloc0 = a["queue_allocated"]
+        # static (queue, rank) order for the per-round queue-cap prefixes
+        q_perm = jnp.argsort(task_queue * (T + 1) + rank)
+        s_q = task_queue[q_perm]
+        q_seg_start = jnp.concatenate(
+            [jnp.array([True]), s_q[1:] != s_q[:-1]])
     else:
         task_queue = None
         deserved = None
+        q_perm = q_seg_start = None
         qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
     def phase_rounds(st, use_future: bool):
@@ -357,8 +400,8 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             if use_queue_cap:
                 qrem = jnp.maximum(deserved - qalloc, 0.0)
                 eligible = eligible & _queue_cap_mask(
-                    eligible, task_queue, a["task_req"], qrem, rank, thr,
-                    scalar_mask)
+                    eligible, task_queue, a["task_req"], qrem, thr,
+                    scalar_mask, q_perm, q_seg_start)
             feas = fits_matrix(a["task_init_req"], avail, thr, scalar_mask) & sig_feas
             used_now = a["node_used"] + (a["node_idle"] - idle)
             score = score_matrix(a["task_init_req"], avail, used_now,
@@ -454,7 +497,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     job_ready = ((a["job_ready_base"] + alloc_counts) >= a["job_min"]) \
         & a["job_valid"]
     return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
-                       rounds=rounds)
+                       rounds=rounds, compact=_compact(assigned, kind, N))
 
 
 # ---------------------------------------------------------------------------
@@ -600,7 +643,7 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
     job_ready = ((a["job_ready_base"] + alloc_counts) >= a["job_min"]) \
         & a["job_valid"]
     return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
-                       rounds=jnp.int32(T))
+                       rounds=jnp.int32(T), compact=_compact(assigned, kind, N))
 
 
 # ---------------------------------------------------------------------------
@@ -616,6 +659,32 @@ def _unpack(fbuf, ibuf, layout):
             v = jax.lax.dynamic_slice(ibuf, (off,), (size,)).reshape(shape)
             d[k] = v.astype(bool) if kind == "b" else v
     return d
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
+    "score_families", "use_queue_cap"))
+def solve_allocate_packed2d(f2d, i2d, layout,
+                            score_params: Dict[str, jnp.ndarray],
+                            max_rounds: int = 64,
+                            max_gang_iters: int = 8,
+                            per_node_cap: int = 0,
+                            herd_mode: str = "pack",
+                            score_families: Tuple[str, ...] = ("binpack",),
+                            use_queue_cap: bool = False) -> SolveResult:
+    """solve_allocate over the chunked device-resident buffers kept by
+    ops.device_cache.PackedDeviceCache: per-session upload is only the
+    dirty chunks; the flatten+slice here fuses away on device."""
+    nf = max(off + size for k, kind, off, size, shape in layout
+             if kind == "f")
+    ni = max(off + size for k, kind, off, size, shape in layout
+             if kind != "f")
+    fbuf = f2d.reshape(-1)[:nf]
+    ibuf = i2d.reshape(-1)[:ni]
+    arrays = _unpack(fbuf, ibuf, layout)
+    return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
+                          per_node_cap, herd_mode, score_families,
+                          use_queue_cap)
 
 
 @functools.partial(jax.jit, static_argnames=(
