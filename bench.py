@@ -77,13 +77,12 @@ def make_problem(n_nodes, n_jobs, tasks_per_job, cpu="32", mem="128Gi",
     return jobs, nodes, tasks, queues
 
 
-_demand_cache = {}
-
-
-def fill_queue_demand(arr, jobs):
+def fill_queue_demand(arr, jobs, demand_cache):
     """Bench stand-in for the proportion plugin's session-open attrs:
     request = total demand per queue, allocated = 0. Per-job demand vectors
-    cache on (uid, flat_version) like the flatten's blocks."""
+    cache on (uid, flat_version) like the flatten's blocks; the cache dict
+    is per-config (configs reuse job uids, so sharing one would alias
+    different problems' vectors)."""
     qidx = {q: i for i, q in enumerate(arr.queues_list)}
     arr.queue_request[:] = 0.0
     arr.queue_allocated[:] = 0.0
@@ -91,12 +90,12 @@ def fill_queue_demand(arr, jobs):
         i = qidx.get(job.queue)
         if i is None:
             continue
-        ent = _demand_cache.get(job.uid)
+        ent = demand_cache.get(job.uid)
         if ent is None or ent[0] != job.flat_version \
                 or ent[1].shape[0] != arr.R:
             ent = (job.flat_version,
                    job.total_request.to_vector(arr.vocab))
-            _demand_cache[job.uid] = ent
+            demand_cache[job.uid] = ent
         arr.queue_request[i] += ent[1]
 
 
@@ -113,6 +112,7 @@ def headline():
         n_nodes, n_jobs, tpj, n_queues=3, queue_weights=[1, 2, 3])
     node_list = list(nodes.values())
     fcache, dcache = FlattenCache(), PackedDeviceCache()
+    demand_cache = {}
 
     held = {}
 
@@ -147,7 +147,7 @@ def headline():
     def one_session(jobs_s, tasks_s):
         arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
                                queues=queues)
-        fill_queue_demand(arr, jobs_s)
+        fill_queue_demand(arr, jobs_s, demand_cache)
         fbuf, ibuf, layout = arr.packed()
         f2d, i2d = dcache.update(fbuf, ibuf, layout)
         params = _params(arr)
@@ -176,7 +176,7 @@ def headline():
     t0 = time.perf_counter()
     arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
                            queues=queues)
-    fill_queue_demand(arr, jobs_s)
+    fill_queue_demand(arr, jobs_s, demand_cache)
     arr.packed()
     flatten_ms = (time.perf_counter() - t0) * 1e3
 
@@ -188,7 +188,7 @@ def headline():
     r.compact.block_until_ready()
     arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
                            queues=queues)
-    fill_queue_demand(arr, jobs_s)
+    fill_queue_demand(arr, jobs_s, demand_cache)
     fbuf, ibuf, layout = arr.packed()
     f2d, i2d = dcache.update(fbuf, ibuf, layout)
     params = _params(arr)
@@ -366,8 +366,9 @@ def config5_hierarchical():
         1000, 500, 10, cpu="16", mem="64Gi",
         n_queues=4, queue_weights=[1, 2, 3, 4], gpu_every=5)
     fcache, dcache = FlattenCache(), PackedDeviceCache()
+    demand_cache = {}
     arr = flatten_snapshot(jobs, nodes, tasks, cache=fcache, queues=queues)
-    fill_queue_demand(arr, jobs)
+    fill_queue_demand(arr, jobs, demand_cache)
     fbuf, ibuf, layout = arr.packed()
     f2d, i2d = dcache.update(fbuf, ibuf, layout)
     params = _params(arr)
@@ -376,7 +377,7 @@ def config5_hierarchical():
     res.assigned.block_until_ready()
     t0 = time.perf_counter()
     arr = flatten_snapshot(jobs, nodes, tasks, cache=fcache, queues=queues)
-    fill_queue_demand(arr, jobs)
+    fill_queue_demand(arr, jobs, demand_cache)
     fbuf, ibuf, layout = arr.packed()
     f2d, i2d = dcache.update(fbuf, ibuf, layout)
     res = solve_allocate_packed2d(f2d, i2d, layout, params,

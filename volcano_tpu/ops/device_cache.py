@@ -88,10 +88,18 @@ class PackedDeviceCache:
                          != self._host_f.reshape(cf, c)).any(axis=1))[0]
         di = np.nonzero((i2.reshape(ci, c)
                          != self._host_i.reshape(ci, c)).any(axis=1))[0]
-        self._host_f, self._host_i = f2, i2
         self.last_shipped_chunks = int(df.size + di.size)
-        self._dev_f = self._apply(self._dev_f, df, f2.reshape(cf, c))
-        self._dev_i = self._apply(self._dev_i, di, i2.reshape(ci, c))
+        try:
+            new_f = self._apply(self._dev_f, df, f2.reshape(cf, c))
+            new_i = self._apply(self._dev_i, di, i2.reshape(ci, c))
+        except Exception:
+            # a partial scatter (or a donated-buffer loss) would desync the
+            # device copy from the host mirror: drop everything so the next
+            # session re-ships in full instead of solving on stale data
+            self.reset()
+            raise
+        self._dev_f, self._dev_i = new_f, new_i
+        self._host_f, self._host_i = f2, i2
         return self._dev_f, self._dev_i
 
     @staticmethod

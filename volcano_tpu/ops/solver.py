@@ -206,11 +206,17 @@ def _queue_cap_mask(eligible, task_queue, req, qrem, thr, scalar_mask,
     T = req.shape[0]
     s_q = task_queue[q_perm]
     s_act = eligible[q_perm]
-    s_req = req[q_perm] * s_act[:, None]
-    prefix = _segment_prefix(s_req, q_seg_start)
     s_rem = qrem[s_q]
+    # a task whose own request can never fit the queue's remaining deserve
+    # must not hold budget in the prefix — the sequential reference only
+    # charges the queue on actual placement, so a too-big task ahead in
+    # rank order doesn't starve feasible tasks behind it
+    s_fits_alone = le_fits(req[q_perm], s_rem, thr, scalar_mask,
+                           ignore_req=req[q_perm]) & s_act
+    s_req = req[q_perm] * s_fits_alone[:, None]
+    prefix = _segment_prefix(s_req, q_seg_start)
     ok_sorted = le_fits(prefix + s_req, s_rem, thr, scalar_mask,
-                        ignore_req=s_req) & s_act
+                        ignore_req=s_req) & s_fits_alone
     return jnp.zeros(T, dtype=bool).at[q_perm].set(ok_sorted)
 
 
@@ -438,7 +444,8 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         return out[:-1]
 
     def gang_body(s):
-        idle, pipe, npods, qalloc, assigned, kind, excluded, rounds, _, it = s
+        (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
+         _, it, reverted_once) = s
         st = (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds)
         st = phase_rounds(st, use_future=False)
         st = phase_rounds(st, use_future=True)
@@ -476,21 +483,27 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 num_segments=Q)
         assigned = jnp.where(revert_task, -1, assigned)
         kind = jnp.where(revert_task, -1, kind)
-        excluded = excluded | revert_job
+        # one retry per job: a first revert leaves the job eligible for the
+        # next gang iteration (another job's revert — often the cause of its
+        # failure — may have freed room); a second revert excludes it for
+        # good, keeping the fixpoint bounded
+        excluded = excluded | (revert_job & reverted_once)
+        reverted_once = reverted_once | revert_job
         any_revert = jnp.any(revert_job)
         return (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
-                any_revert, it + 1)
+                any_revert, it + 1, reverted_once)
 
     init = (a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"],
             qalloc0,
             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
-            ~a["job_valid"], jnp.int32(0), jnp.bool_(True), jnp.int32(0))
+            ~a["job_valid"], jnp.int32(0), jnp.bool_(True), jnp.int32(0),
+            jnp.zeros(J, dtype=bool))
     # bounded gang fixpoint: rerun phases while any job got reverted (its
-    # freed resources may admit other jobs); reverted jobs stay excluded
+    # freed resources may admit other jobs)
     s = jax.lax.while_loop(
-        lambda s: s[-2] & (s[-1] < max_gang_iters), gang_body, init)
+        lambda s: s[-3] & (s[-2] < max_gang_iters), gang_body, init)
 
-    idle, pipe, npods, _, assigned, kind, excluded, rounds, _, _ = s
+    idle, pipe, npods, _, assigned, kind, excluded, rounds, _, _, _ = s
     alloc_counts = jax.ops.segment_sum(
         ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
         a["task_job"], num_segments=J)
