@@ -213,8 +213,12 @@ class AllocateAction(Action):
                         stmt.allocate(task, node_name)
                     else:
                         ssn.pipeline(task, node_name)
-                except (KeyError, ValueError):
+                except (KeyError, ValueError) as e:
                     log.exception("replay failed for %s", task.key)
+                    fe = FitErrors()
+                    fe.set_node_error(node_name, FitError(
+                        task, node_name, [str(e)]))
+                    job.nodes_fit_errors[task.key] = fe
             if ssn.job_ready(job):
                 stmt.commit()
             else:
@@ -346,10 +350,17 @@ class AllocateAction(Action):
                 best = ssn.best_node_fn(task, scores)
                 if best is None:
                     best = max(candidates, key=lambda n: scores[n.name])
-                if task.init_resreq.less_equal(best.idle):
-                    stmt.allocate(task, best.name)
-                else:
-                    ssn.pipeline(task, best.name)
+                try:
+                    if task.init_resreq.less_equal(best.idle):
+                        stmt.allocate(task, best.name)
+                    else:
+                        ssn.pipeline(task, best.name)
+                except ValueError as e:
+                    # e.g. AllocateVolumes failure (allocate.go:232-237
+                    # logs and moves on; the resync path re-tries later)
+                    log.warning("allocate failed for %s on %s: %s",
+                                task.key, best.name, e)
+                    continue
                 if ssn.job_ready(job) and tasks:
                     jobs.push(job)
                     break

@@ -15,6 +15,8 @@ POD_AFFINITY_FAILED = "node(s) didn't match pod affinity/anti-affinity"
 NODE_PORTS_FAILED = "node(s) didn't have free ports for the requested pod ports"
 GPU_SHARING_FAILED = "no enough gpu memory on single device"
 POD_COUNT_FAILED = "node(s) had too many pods"
+VOLUME_BINDING_FAILED = "node(s) didn't match the pod's volume node affinity"
+PVC_NOT_FOUND = "persistentvolumeclaim not found"
 
 
 class FitError:

@@ -81,16 +81,143 @@ class DefaultStatusUpdater:
         self.cluster.apply("podgroups", pg)
 
 
+#: the WaitForFirstConsumer node pin (k8s volume-scheduling annotation)
+SELECTED_NODE_ANNOTATION = "volume.kubernetes.io/selected-node"
+
+
 class DefaultVolumeBinder:
-    """Volume Assume/Bind. The TPU build has no real PV controller; volumes
-    named in the pod spec are marked ready immediately (the seam exists so a
-    real CSI-backed implementation can plug in)."""
+    """WaitForFirstConsumer-style claim Assume/Bind against the cluster
+    store (reference pkg/scheduler/cache/cache.go:234-254, which wraps k8s
+    volumescheduling's AssumePodVolumes/BindPodVolumes; here the store
+    itself plays the PV controller).
+
+    allocate_volumes (statement.go:230-282's AllocateVolumes step) verifies
+    every claim the pod references exists and is bindable on the chosen
+    node, then records the tentative selection in memory — nothing is
+    written. bind_volumes (statement Commit) writes the selected-node pin
+    and flips the claim Bound; a write failure raises, and the statement's
+    commit handler unwinds + resyncs the task. revert_volumes (statement
+    Discard) drops the in-memory assumption."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        # pod uid -> {(ns, claim): node} — in-flight Assume decisions,
+        # visible to later assumes/predicates like volumescheduling's
+        # assume cache (two same-session pods sharing a claim must agree);
+        # session-scoped: the scheduler drops them at the next snapshot
+        self._assumed: Dict[str, Dict[tuple, str]] = {}
+        # reverse index for O(1) pin lookups on the predicate hot path
+        self._assumed_by_claim: Dict[tuple, str] = {}
+
+    @staticmethod
+    def _claims(pod):
+        for vol in getattr(pod, "volumes", None) or []:
+            ref = (vol.get("persistentVolumeClaim") or {}).get("claimName")
+            if ref:
+                yield ref
+
+    def missing_claims(self, pod) -> List[str]:
+        return [name for name in self._claims(pod)
+                if self.cluster.try_get("pvcs", name, pod.namespace) is None]
+
+    def _pinned_node(self, key) -> Optional[str]:
+        """Node a claim is pinned to: a written selected-node annotation,
+        or any in-flight assumption. None = claim missing."""
+        pvc = self.cluster.try_get("pvcs", key[1], key[0])
+        if pvc is None:
+            return None
+        sel = (pvc.annotations or {}).get(SELECTED_NODE_ANNOTATION, "")
+        return sel or self._assumed_by_claim.get(key, "")
+
+    def node_ok(self, pod, hostname: str) -> bool:
+        """Predicate half (volume-binding filter): every claim must exist
+        and be unpinned or pinned to this node."""
+        for name in self._claims(pod):
+            sel = self._pinned_node((pod.namespace, name))
+            if sel is None or (sel and sel != hostname):
+                return False
+        return True
+
+    def drop_assumptions(self) -> None:
+        """Called at snapshot time: assumptions are session-scoped (an
+        uncommitted assume from a job that never dispatched must not pin
+        the claim forever)."""
+        self._assumed.clear()
+        self._assumed_by_claim.clear()
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        pod = task.pod
+        assumed = {}
+        for name in self._claims(pod):
+            key = (pod.namespace, name)
+            sel = self._pinned_node(key)
+            if sel is None:
+                raise ValueError(
+                    f"pvc <{pod.namespace}/{name}> for task <{task.key}> "
+                    "not found")
+            if sel and sel != hostname:
+                raise ValueError(
+                    f"pvc <{pod.namespace}/{name}> is pinned to node "
+                    f"<{sel}>, cannot allocate <{task.key}> on <{hostname}>")
+            assumed[key] = hostname
+        if assumed:
+            self._assumed[pod.uid] = assumed
+            self._assumed_by_claim.update(assumed)
         task.volume_ready = True
 
+    def _drop_pod(self, pod_uid: str) -> Optional[Dict[tuple, str]]:
+        assumed = self._assumed.pop(pod_uid, None)
+        if assumed:
+            for key in assumed:
+                # keep the reverse entry if another in-flight pod still
+                # assumes the same claim (same node by construction)
+                if not any(key in m for m in self._assumed.values()):
+                    self._assumed_by_claim.pop(key, None)
+        return assumed
+
     def bind_volumes(self, task: TaskInfo) -> None:
-        pass
+        pod = task.pod
+        assumed = self._drop_pod(pod.uid)
+        if not assumed:
+            return
+        written = []
+        try:
+            for (ns, name), node in assumed.items():
+                pvc = self.cluster.get("pvcs", name, ns)
+                sel = (pvc.annotations or {}).get(
+                    SELECTED_NODE_ANNOTATION, "")
+                if sel and sel != node:
+                    raise ValueError(
+                        f"pvc <{ns}/{name}> was bound to <{sel}> while "
+                        f"assumed on <{node}>")
+                prev = (pvc.annotations.get(SELECTED_NODE_ANNOTATION),
+                        pvc.phase, pvc.volume_name)
+                pvc.annotations[SELECTED_NODE_ANNOTATION] = node
+                pvc.phase = "Bound"
+                pvc.volume_name = pvc.volume_name or f"pv-{name}"
+                self.cluster.update("pvcs", pvc)
+                written.append((pvc, prev))
+        except Exception:
+            # unwind partial multi-claim binds so one stuck claim can't
+            # strand the pod half-pinned forever
+            for pvc, (prev_sel, prev_phase, prev_vol) in reversed(written):
+                if prev_sel is None:
+                    pvc.annotations.pop(SELECTED_NODE_ANNOTATION, None)
+                else:
+                    pvc.annotations[SELECTED_NODE_ANNOTATION] = prev_sel
+                pvc.phase = prev_phase
+                pvc.volume_name = prev_vol
+                try:
+                    self.cluster.update("pvcs", pvc)
+                except Exception:
+                    log.exception("failed to unwind pvc bind for %s",
+                                  pvc.name)
+            task.volume_ready = False
+            raise
+
+    def revert_volumes(self, task: TaskInfo) -> None:
+        if self._drop_pod(task.pod.uid) is not None:
+            task.volume_ready = False
 
 
 class SchedulerCache:
@@ -114,7 +241,7 @@ class SchedulerCache:
         self.binder = DefaultBinder(self.cluster)
         self.evictor = DefaultEvictor(self.cluster)
         self.status_updater = DefaultStatusUpdater(self.cluster)
-        self.volume_binder = DefaultVolumeBinder()
+        self.volume_binder = DefaultVolumeBinder(self.cluster)
 
         self._err_tasks: List[TaskInfo] = []
         self._synced = False
@@ -341,6 +468,9 @@ class SchedulerCache:
     # -- snapshot (cache.go:670-748) ----------------------------------------
 
     def snapshot(self) -> ClusterInfo:
+        drop = getattr(self.volume_binder, "drop_assumptions", None)
+        if drop is not None:
+            drop()  # assumptions are session-scoped
         sn = ClusterInfo()
         for name, ni in self.nodes.items():
             if not ni.ready:
@@ -420,6 +550,11 @@ class SchedulerCache:
 
     def bind_volumes(self, task: TaskInfo) -> None:
         self.volume_binder.bind_volumes(task)
+
+    def revert_volumes(self, task: TaskInfo) -> None:
+        revert = getattr(self.volume_binder, "revert_volumes", None)
+        if revert is not None:
+            revert(task)
 
     def task_unschedulable(self, task: TaskInfo, message: str) -> None:
         """Write the Unschedulable pod condition (cache.go:590-612)."""

@@ -45,3 +45,6 @@ class FakeVolumeBinder:
 
     def bind_volumes(self, task) -> None:
         pass
+
+    def revert_volumes(self, task) -> None:
+        pass

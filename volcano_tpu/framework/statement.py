@@ -113,6 +113,9 @@ class Statement:
             raise
 
     def _unallocate(self, task: TaskInfo) -> None:
+        revert = getattr(self.ssn.cache, "revert_volumes", None)
+        if revert is not None:
+            revert(task)  # drop the AllocateVolumes assumption
         job = self.ssn.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.PENDING)

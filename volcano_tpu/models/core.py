@@ -34,6 +34,7 @@ class Pod:
     init_containers: List[Dict[str, Any]] = field(default_factory=list)
     node_name: str = ""            # spec.nodeName (set on bind)
     node_selector: Dict[str, str] = field(default_factory=dict)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)  # [{'name':..., 'persistentVolumeClaim': {'claimName':...}}]
     affinity: Optional[Dict[str, Any]] = None
     tolerations: List[Dict[str, Any]] = field(default_factory=list)
     scheduler_name: str = "volcano"
@@ -94,6 +95,10 @@ class PersistentVolumeClaim:
     name: str
     namespace: str = "default"
     spec: Dict[str, Any] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    phase: str = "Pending"         # Pending until bound to a volume
+    volume_name: str = ""
+    resource_version: int = 0
 
 
 @dataclass

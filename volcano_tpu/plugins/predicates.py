@@ -22,7 +22,8 @@ from ..api.device_info import (
 )
 from ..api.unschedule_info import (
     GPU_SHARING_FAILED, NODE_AFFINITY_FAILED, NODE_PORTS_FAILED,
-    NODE_UNSCHEDULABLE, POD_AFFINITY_FAILED, POD_COUNT_FAILED, TAINT_FAILED,
+    NODE_UNSCHEDULABLE, POD_AFFINITY_FAILED, POD_COUNT_FAILED,
+    PVC_NOT_FOUND, TAINT_FAILED, VOLUME_BINDING_FAILED,
 )
 from ..framework import Plugin
 from ..framework.event import EventHandler
@@ -94,9 +95,15 @@ class PredicatesPlugin(Plugin):
         # Only pending tasks matter: _pod_affinity_ok evaluates the incoming
         # pod's terms, never existing pods' (no anti-affinity symmetry), so a
         # long-Running affine pod must not downgrade any cycle to host mode.
+        # PVC-carrying jobs join the same host routing: the kernel's sig
+        # masks don't know claim node pins, and a wrong-node replay would
+        # silently discard the gang every cycle (claim pins also depend on
+        # in-flight same-session assumptions, which only the host loop's
+        # volume-binding predicate tracks).
         host_only = {
             job.uid for job in ssn.jobs.values()
             if any(_has_required_pod_affinity(t.pod)
+                   or getattr(t.pod, "volumes", None)
                    for t in job.task_status_index.get(
                        TaskStatus.PENDING, {}).values())}
         if host_only:
@@ -187,6 +194,17 @@ class PredicatesPlugin(Plugin):
                         and predicate_gpu(pod, node_info) < 0:
                     # no single card has enough idle memory (gpu.go:27-55)
                     reasons.append(GPU_SHARING_FAILED)
+                if getattr(pod, "volumes", None):
+                    # volume-binding filter: a claim pinned to another node
+                    # excludes this one (the k8s CheckVolumeBinding
+                    # predicate the reference wires in)
+                    vb = getattr(getattr(ssn, "cache", None),
+                                 "volume_binder", None)
+                    if getattr(vb, "missing_claims", lambda p: ())(pod):
+                        reasons.append(PVC_NOT_FOUND)
+                    elif getattr(vb, "node_ok", None) is not None \
+                            and not vb.node_ok(pod, node.name):
+                        reasons.append(VOLUME_BINDING_FAILED)
             if reasons:
                 raise PredicateError(FitError(task, node_info.name, reasons))
 
