@@ -1,0 +1,92 @@
+"""Solver-sidecar process boundary (parallel/sidecar.py): packed snapshot
+request over a unix socket, assignment response, device cache server-side.
+
+The server runs in a background thread here (the socket protocol and the
+allocate-action integration are what's under test; ``main()`` is the thin
+process entry point the deployment uses)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.conf import PluginOption, Tier
+from volcano_tpu.framework import close_session, get_action, open_session
+from volcano_tpu.parallel.sidecar import SidecarSolver, SolverServer
+
+from helpers import build_node, build_pod, build_pod_group
+
+
+@pytest.fixture
+def sidecar(tmp_path):
+    path = str(tmp_path / "solver.sock")
+    server = SolverServer(path)
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    for _ in range(100):
+        try:
+            client = SidecarSolver(path)
+            client._connect()
+            break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        pytest.fail("sidecar server did not come up")
+    yield client
+    try:
+        client.shutdown_server()
+    except Exception:
+        server.stop()
+    th.join(timeout=5)
+
+
+def test_roundtrip_matches_local_solve(sidecar):
+    from __graft_entry__ import _make_problem, _params
+    from volcano_tpu.ops import flatten_snapshot
+    from volcano_tpu.ops.solver import solve_allocate_packed
+
+    jobs, nodes, tasks = _make_problem(n_nodes=8, n_jobs=4, tasks_per_job=3)
+    arr = flatten_snapshot(jobs, nodes, tasks)
+    fbuf, ibuf, layout = arr.packed()
+    params = _params(arr)
+    assigned, kind, info = sidecar.solve(fbuf, ibuf, layout, params)
+    local = solve_allocate_packed(fbuf, ibuf, layout, params)
+    assert np.array_equal(assigned, np.asarray(local.assigned))
+    assert np.array_equal(kind, np.asarray(local.kind))
+    assert info["shipped_chunks"] > 0  # first request ships everything
+
+    # second solve over the same connection: server-side device cache
+    # diffs against the previous upload
+    assigned2, _, info2 = sidecar.solve(fbuf, ibuf, layout, params)
+    assert np.array_equal(assigned2, assigned)
+    assert info2["shipped_chunks"] == 0
+
+
+def test_allocate_action_through_sidecar(sidecar):
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.sidecar = sidecar
+    # prove the solve goes through the sidecar: no in-process fallback
+    cache.device_cache = None
+    cache.run()
+    store.create("nodes", build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+    store.create("nodes", build_node("n2", {"cpu": "4", "memory": "8Gi"}))
+    store.create("podgroups", build_pod_group("pg1", "c1", min_member=2))
+    for i in (1, 2):
+        store.create("pods", build_pod(
+            "c1", f"p{i}", "", "Pending",
+            {"cpu": "2", "memory": "1Gi"}, "pg1"))
+    tiers = [Tier(plugins=[PluginOption(name="gang"),
+                           PluginOption(name="priority")]),
+             Tier(plugins=[PluginOption(name="predicates"),
+                           PluginOption(name="nodeorder")])]
+    ssn = open_session(cache, tiers)
+    assert ssn.sidecar is sidecar
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    assert len(cache.binder.binds) == 2

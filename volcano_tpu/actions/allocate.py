@@ -169,10 +169,19 @@ class AllocateAction(Action):
                 + params["balanced_weight"]) else "spread"
 
         dc = getattr(ssn, "device_cache", None)
+        sidecar = getattr(ssn, "sidecar", None)
         if sequential:
             res = solve_allocate_sequential(
                 arr.device_dict(), params, score_families=families,
                 use_queue_cap=use_queue_cap)
+        elif sidecar is not None:
+            # process boundary: ship the packed snapshot to the solver
+            # sidecar (which owns the TPU) and replay its assignments
+            fbuf, ibuf, layout = arr.packed()
+            assigned, kind, _info = sidecar.solve(
+                fbuf, ibuf, layout, params, herd_mode=herd,
+                score_families=families, use_queue_cap=use_queue_cap)
+            res = None
         elif dc is not None:
             # device-resident buffers: per-session upload = dirty chunks only
             from ..ops.solver import solve_allocate_packed2d
@@ -185,14 +194,16 @@ class AllocateAction(Action):
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
                 score_families=families, use_queue_cap=use_queue_cap)
-        # one int16 readback instead of two int32 ones: the tunnel to a
-        # remote chip is bandwidth-poor, so the result wire format matters
-        from ..ops.solver import COMPACT_KIND_SHIFT, decode_compact
-        if arr.N <= (1 << COMPACT_KIND_SHIFT):
-            assigned, kind = decode_compact(res.compact)
-        else:  # >16k nodes: node index overflows the int16 packing
-            assigned = np.asarray(res.assigned)
-            kind = np.asarray(res.kind)
+        if res is not None:
+            # one int16 readback instead of two int32 ones: the tunnel to a
+            # remote chip is bandwidth-poor, so the result wire format
+            # matters (the sidecar path already returned host arrays)
+            from ..ops.solver import COMPACT_KIND_SHIFT, decode_compact
+            if arr.N <= (1 << COMPACT_KIND_SHIFT):
+                assigned, kind = decode_compact(res.compact)
+            else:  # >16k nodes: node index overflows the int16 packing
+                assigned = np.asarray(res.assigned)
+                kind = np.asarray(res.kind)
 
         # replay through the Statement boundary in job order
         idx = 0

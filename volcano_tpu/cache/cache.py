@@ -253,6 +253,10 @@ class SchedulerCache:
         self.flatten_cache = FlattenCache()
         # device-resident packed solver buffers (delta-shipped per session)
         self.device_cache = PackedDeviceCache()
+        # optional solver-sidecar client (parallel.sidecar.SidecarSolver):
+        # when set, allocate ships snapshots to the solver process instead
+        # of running the kernel in-process
+        self.sidecar = None
 
         self._create_default_queue()
 

@@ -92,6 +92,7 @@ class Session:
         self.solver_options: Dict[str, object] = {}
         self.flatten_cache = getattr(cache, "flatten_cache", None)
         self.device_cache = getattr(cache, "device_cache", None)
+        self.sidecar = getattr(cache, "sidecar", None)
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)

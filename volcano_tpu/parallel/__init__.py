@@ -1,3 +1,5 @@
-"""Device-mesh sharding of the solver (the multi-chip scale axis)."""
+"""Device-mesh sharding of the solver (the multi-chip scale axis) and the
+solver-sidecar process boundary."""
 
 from .sharded_solver import make_mesh, solve_allocate_sharded  # noqa: F401
+from .sidecar import SidecarSolver, SolverServer  # noqa: F401

@@ -1,0 +1,235 @@
+"""Solver sidecar: the snapshot-request / assignment-response process
+boundary (BASELINE.json north-star architecture).
+
+The reference's scheduler is separated from its cluster by the Kubernetes
+API-server protocol (informers in, bind/evict writes out —
+pkg/scheduler/cache/cache.go:319-402). The TPU build's analogous seam
+splits the control plane (session, statement, plugins, effectors) from the
+JAX solver: the control plane packs the snapshot (SnapshotArrays.packed)
+and ships it over a local unix socket; the sidecar process owns the TPU,
+keeps the buffers device-resident across sessions (PackedDeviceCache —
+deltas computed server-side, so the socket carries plain full buffers),
+runs the solve, and returns the compact assignment vector.
+
+Why a process boundary: the control plane stays a lightweight pure-Python
+process (restartable, debuggable, no TPU runtime linked in — the drop-in
+property the reference gets from speaking only the API-server protocol),
+while the solver process pins the chip. Protocol: length-prefixed frames,
+a JSON header + raw little-endian array bytes; no serialization library
+needed and nothing to keep in sync with a schema compiler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_MAGIC = b"VTS1"
+
+
+# -- framing ----------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, header: dict, blobs) -> None:
+    meta = dict(header)
+    meta["blobs"] = [{"dtype": str(b.dtype), "shape": list(b.shape)}
+                     for b in blobs]
+    hdr = json.dumps(meta).encode()
+    sock.sendall(_MAGIC + struct.pack("<I", len(hdr)) + hdr)
+    for b in blobs:
+        raw = np.ascontiguousarray(b).tobytes()
+        sock.sendall(struct.pack("<Q", len(raw)) + raw)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("sidecar socket closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket):
+    magic = _recv_exact(sock, 4)
+    if magic != _MAGIC:
+        raise ConnectionError(f"bad magic {magic!r}")
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    blobs = []
+    for spec in header.pop("blobs", []):
+        (blen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        arr = np.frombuffer(_recv_exact(sock, blen),
+                            dtype=np.dtype(spec["dtype"]))
+        blobs.append(arr.reshape(spec["shape"]))
+    return header, blobs
+
+
+def _layout_wire(layout):
+    return [[k, kind, off, size, list(shape)]
+            for k, kind, off, size, shape in layout]
+
+
+def _layout_unwire(wire):
+    return tuple((k, kind, off, size, tuple(shape))
+                 for k, kind, off, size, shape in wire)
+
+
+# -- server (owns the TPU) --------------------------------------------------
+
+class SolverServer:
+    """Accept loop serving solve requests; one at a time (one chip)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._device_cache = None
+
+    def _ensure(self):
+        if self._device_cache is None:
+            from ..ops.device_cache import PackedDeviceCache
+            self._device_cache = PackedDeviceCache()
+        return self._device_cache
+
+    def _solve(self, header, blobs):
+        from ..ops.solver import solve_allocate_packed2d
+
+        fbuf, ibuf = blobs[0], blobs[1]
+        params = {}
+        for name, blob in zip(header["param_names"], blobs[2:]):
+            params[name] = blob if blob.ndim else np.float32(blob)
+        layout = _layout_unwire(header["layout"])
+        dcache = self._ensure()
+        f2d, i2d = dcache.update(fbuf, ibuf, layout)
+        res = solve_allocate_packed2d(
+            f2d, i2d, layout, params,
+            herd_mode=header["herd_mode"],
+            score_families=tuple(header["score_families"]),
+            use_queue_cap=header["use_queue_cap"])
+        return {"rounds": int(np.asarray(res.rounds)),
+                "shipped_chunks": dcache.last_shipped_chunks}, \
+            [np.asarray(res.assigned), np.asarray(res.kind)]
+
+    def serve_forever(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.path)
+        self._listener.listen(1)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break
+            with conn:
+                try:
+                    while True:
+                        header, blobs = _recv_frame(conn)
+                        if header.get("op") == "shutdown":
+                            self._stop.set()
+                            return
+                        try:
+                            out_header, out_blobs = self._solve(header,
+                                                                blobs)
+                        except Exception as e:  # noqa: BLE001
+                            # a bad request must not kill the server or
+                            # leave the client hanging: answer with an
+                            # error frame and keep serving
+                            out_header = {"error": f"{type(e).__name__}: "
+                                                   f"{e}"}
+                            out_blobs = []
+                        _send_frame(conn, out_header, out_blobs)
+                except (ConnectionError, OSError):
+                    continue  # client went away; await the next one
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.close()
+
+
+# -- client (the control plane side) ----------------------------------------
+
+class SidecarSolver:
+    """Drop-in allocate solve over the sidecar socket. The allocate action
+    uses it instead of the in-process kernel when the session exposes one
+    (SchedulerCache.sidecar)."""
+
+    def __init__(self, path: str, timeout: float = 120.0):
+        self.path = path
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self.timeout)
+            s.connect(self.path)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def shutdown_server(self) -> None:
+        sock = self._connect()
+        _send_frame(sock, {"op": "shutdown"}, [])
+        self.close()
+
+    def solve(self, fbuf, ibuf, layout, params,
+              herd_mode: str = "pack",
+              score_families: Tuple[str, ...] = ("binpack",),
+              use_queue_cap: bool = False):
+        """Returns (assigned [T] int32, kind [T] int32, info dict)."""
+        names, blobs = [], [fbuf, ibuf]
+        for name, val in params.items():
+            names.append(name)
+            blobs.append(np.asarray(val))
+        header = {
+            "op": "solve",
+            "layout": _layout_wire(layout),
+            "param_names": names,
+            "herd_mode": herd_mode,
+            "score_families": list(score_families),
+            "use_queue_cap": bool(use_queue_cap),
+        }
+        try:
+            sock = self._connect()
+            _send_frame(sock, header, blobs)
+            out_header, out_blobs = _recv_frame(sock)
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+        if "error" in out_header:
+            raise RuntimeError(
+                f"sidecar solve failed: {out_header['error']}")
+        return out_blobs[0], out_blobs[1], out_header
+
+
+def main(argv=None) -> int:
+    """``python -m volcano_tpu.parallel.sidecar /path/to.sock`` — the
+    solver process entry point (owns the TPU)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="volcano-solver-sidecar")
+    ap.add_argument("socket_path")
+    args = ap.parse_args(argv)
+    server = SolverServer(args.socket_path)
+    print(f"solver sidecar listening on {args.socket_path}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,10 +100,13 @@ class PredicatesPlugin(Plugin):
         # silently discard the gang every cycle (claim pins also depend on
         # in-flight same-session assumptions, which only the host loop's
         # volume-binding predicate tracks).
+        def _has_claim(pod):
+            return any((v.get("persistentVolumeClaim") or {}).get(
+                "claimName") for v in getattr(pod, "volumes", None) or [])
+
         host_only = {
             job.uid for job in ssn.jobs.values()
-            if any(_has_required_pod_affinity(t.pod)
-                   or getattr(t.pod, "volumes", None)
+            if any(_has_required_pod_affinity(t.pod) or _has_claim(t.pod)
                    for t in job.task_status_index.get(
                        TaskStatus.PENDING, {}).values())}
         if host_only:
