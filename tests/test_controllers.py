@@ -150,6 +150,10 @@ class TestJobController:
         cmap = store.get("configmaps", "job1-svc", "default")
         assert cmap.data["task.host"] == "job1-task-0.job1\njob1-task-1.job1"
         assert store.get("services", "job1", "default").spec["clusterIP"] == "None"
+        np_obj = store.get("networkpolicies", "job1", "default")
+        assert np_obj.spec["podSelector"]["matchLabels"][
+            "volcano.sh/job-name"] == "job1"
+        assert np_obj.spec["policyTypes"] == ["Ingress"]
         secret = store.get("secrets", "job1-ssh", "default")
         assert set(secret.data) >= {"id_rsa", "id_rsa.pub", "authorized_keys"}
         pod = store.get("pods", "job1-task-1", "default")

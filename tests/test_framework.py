@@ -270,3 +270,34 @@ class TestPriorityQueue:
         assert pq.pop() == (2, "b")  # FIFO among equals
         assert pq.pop() == (2, "c")
         assert pq.pop() is None
+
+
+class TestNodeSampling:
+    """Adaptive feasible-node sampling (scheduler_helper.go:50-128)."""
+
+    def test_default_scans_everything(self):
+        from volcano_tpu.utils import NodeSampler
+        assert NodeSampler(100).feasible_nodes_to_find(5000) == 5000
+
+    def test_floors_clamp_up(self):
+        from volcano_tpu.utils import NodeSampler
+        s = NodeSampler(10)
+        # small clusters always scan fully
+        assert s.feasible_nodes_to_find(80) == 80
+        # 10% of 5000 = 500
+        assert s.feasible_nodes_to_find(5000) == 500
+        # percentage below the 5% floor clamps up
+        assert NodeSampler(1).feasible_nodes_to_find(5000) == 250
+        # count floor: never below 100 nodes
+        assert NodeSampler(1).feasible_nodes_to_find(1500) == 100
+
+    def test_cursor_advances_past_visited(self):
+        from volcano_tpu.utils import NodeSampler
+        s = NodeSampler(10)
+        nodes = list(range(1000))
+        first, want = s.plan(nodes)
+        assert sorted(first) == nodes  # a rotation, not a subset
+        assert want == 100
+        s.advance(700, 1000)  # scan walked 700 nodes to find 100 feasible
+        second, _ = s.plan(nodes)
+        assert second[0] == 700  # next scan starts where the last stopped

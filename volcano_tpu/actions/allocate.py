@@ -334,16 +334,28 @@ class AllocateAction(Action):
             tasks = pending_tasks[job.uid]
 
             stmt = ssn.statement()
+            sampler = getattr(ssn, "node_sampler", None)
             while tasks:
                 task = tasks.pop(0)
                 fit_errors = FitErrors()
                 candidates = []
-                for node in ssn.nodes.values():
+                all_nodes = list(ssn.nodes.values())
+                if sampler is not None:
+                    node_list, want = sampler.plan(all_nodes)
+                else:
+                    node_list, want = all_nodes, len(all_nodes)
+                visited = 0
+                for node in node_list:
+                    visited += 1
                     try:
                         self._predicate(ssn, task, node)
                         candidates.append(node)
+                        if len(candidates) >= want:
+                            break  # adaptive sampling: enough feasible nodes
                     except PredicateError as e:
                         fit_errors.set_node_error(node.name, e.fit_error)
+                if sampler is not None:
+                    sampler.advance(visited, len(all_nodes))
                 if not candidates:
                     job.nodes_fit_errors[task.key] = fit_errors
                     break

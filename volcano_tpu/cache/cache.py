@@ -22,6 +22,7 @@ from ..models import (
     PodGroup, PodGroupCondition, PodGroupPhase, Queue, QueueSpec,
 )
 from ..client.store import ClusterStore, NotFoundError
+from ..metrics import metrics
 
 log = logging.getLogger(__name__)
 
@@ -528,7 +529,15 @@ class SchedulerCache:
             self.binder.bind(task.pod, hostname)
         except Exception:
             log.exception("bind failed for %s", task.key)
+            metrics.schedule_attempts.inc(labels={"result": "error"})
             self.resync_task(task)
+            return
+        metrics.schedule_attempts.inc(labels={"result": "scheduled"})
+        start = (job.schedule_start_timestamp
+                 or task.pod.creation_timestamp or 0.0)
+        if start:
+            metrics.task_scheduling_latency.observe(
+                (time.time() - start) * 1e3)
 
     def evict(self, ti: TaskInfo, reason: str) -> None:
         job, task = self._find_job_and_task(ti)
@@ -562,6 +571,7 @@ class SchedulerCache:
 
     def task_unschedulable(self, task: TaskInfo, message: str) -> None:
         """Write the Unschedulable pod condition (cache.go:590-612)."""
+        metrics.schedule_attempts.inc(labels={"result": "unschedulable"})
         self.status_updater.update_pod_condition(task.pod, {
             "type": "PodScheduled", "status": "False",
             "reason": "Unschedulable", "message": message,

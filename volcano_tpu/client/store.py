@@ -24,7 +24,7 @@ Interceptor = Callable[[str, str, Any], Any]  # (verb, kind, obj) -> obj (may ra
 KINDS = (
     "pods", "nodes", "podgroups", "queues", "priorityclasses",
     "resourcequotas", "jobs", "commands", "services", "configmaps",
-    "secrets", "pvcs", "leases",
+    "secrets", "pvcs", "leases", "networkpolicies",
 )
 
 

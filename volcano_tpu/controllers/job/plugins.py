@@ -14,7 +14,7 @@ import hashlib
 from typing import Callable, Dict, List
 
 from ...client.store import NotFoundError
-from ...models import ConfigMap, Secret, Service
+from ...models import ConfigMap, NetworkPolicy, Secret, Service
 from ...models.batch import TASK_SPEC_KEY
 
 CONFIG_MAP_TASK_INDEX_ENV = "VC_TASK_INDEX"
@@ -92,6 +92,21 @@ class SvcPlugin:
                                          "uid": job.uid}])
         self.cluster.apply("services", svc)
         if not self.disable_network_policy:
+            # intra-job network isolation: only pods of the same job (or
+            # unlabeled infrastructure) may reach the job's pods
+            # (svc.go:257-304 CreateNetworkPolicyIfNotExist)
+            np_obj = NetworkPolicy(
+                name=job.name, namespace=job.namespace,
+                spec={
+                    "podSelector": {"matchLabels": {
+                        "volcano.sh/job-name": job.name}},
+                    "ingress": [{"from": [{"podSelector": {"matchLabels": {
+                        "volcano.sh/job-name": job.name}}}]}],
+                    "policyTypes": ["Ingress"],
+                },
+                owner_references=[{"kind": "Job", "name": job.name,
+                                   "uid": job.uid}])
+            self.cluster.apply("networkpolicies", np_obj)
             job.status.controlled_resources["plugin-svc-networkpolicy"] = job.name
         job.status.controlled_resources["plugin-svc"] = "svc"
 
@@ -103,12 +118,14 @@ class SvcPlugin:
 
     def on_job_delete(self, job) -> None:
         for kind, name in (("configmaps", self._cm_name(job)),
-                           ("services", job.name)):
+                           ("services", job.name),
+                           ("networkpolicies", job.name)):
             try:
                 self.cluster.delete(kind, name, job.namespace)
             except NotFoundError:
                 pass
         job.status.controlled_resources.pop("plugin-svc", None)
+        job.status.controlled_resources.pop("plugin-svc-networkpolicy", None)
 
     def on_job_update(self, job) -> None:
         cm = self.cluster.try_get("configmaps", self._cm_name(job),

@@ -10,8 +10,8 @@ from .batch import (  # noqa: F401
 )
 from .bus import Action, Command, Event  # noqa: F401
 from .core import (  # noqa: F401
-    ConfigMap, Node, PersistentVolumeClaim, Pod, PriorityClass, ResourceQuota,
-    Secret, Service, new_uid,
+    ConfigMap, NetworkPolicy, Node, PersistentVolumeClaim, Pod,
+    PriorityClass, ResourceQuota, Secret, Service, new_uid,
 )
 from .scheduling import (  # noqa: F401
     PodGroup, PodGroupCondition, PodGroupPhase, PodGroupSpec, PodGroupStatus,

@@ -123,3 +123,11 @@ class Service:
     namespace: str = "default"
     spec: Dict[str, Any] = field(default_factory=dict)
     owner_references: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicy:
+    name: str
+    namespace: str = "default"
+    spec: Dict[str, Any] = field(default_factory=dict)
+    owner_references: List[Dict[str, Any]] = field(default_factory=list)

@@ -45,23 +45,25 @@ def _match_node_selector(selector: Dict[str, str], node) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def taint_tolerated(taint: dict, tolerations: List[dict]) -> bool:
+    for tol in tolerations or []:
+        op = tol.get("operator", "Equal")
+        if tol.get("key") and tol["key"] != taint.get("key"):
+            continue
+        if op == "Equal" and tol.get("value") != taint.get("value"):
+            continue
+        if tol.get("effect") and tol["effect"] != taint.get("effect"):
+            continue
+        return True
+    return False
+
+
 def _tolerates(tolerations: List[dict], node) -> bool:
     """NoSchedule/NoExecute taints must be tolerated (predicates plugin)."""
     for taint in node.taints or []:
         if taint.get("effect") not in ("NoSchedule", "NoExecute"):
             continue
-        tolerated = False
-        for tol in tolerations or []:
-            op = tol.get("operator", "Equal")
-            if tol.get("key") and tol["key"] != taint.get("key"):
-                continue
-            if op == "Equal" and tol.get("value") != taint.get("value"):
-                continue
-            if tol.get("effect") and tol["effect"] != taint.get("effect"):
-                continue
-            tolerated = True
-            break
-        if not tolerated:
+        if not taint_tolerated(taint, tolerations):
             return False
     return True
 

@@ -9,6 +9,7 @@ node-order fn mirrors them per pair.
 from __future__ import annotations
 
 from ..framework import Arguments, Plugin
+from ..ops.arrays import taint_tolerated
 
 
 class NodeOrderPlugin(Plugin):
@@ -53,10 +54,43 @@ class NodeOrderPlugin(Plugin):
                     labels = node.node.labels or {}
                     if all(labels.get(k) == v for k, v in sel.items()):
                         affinity_score += weight
+            # taint-toleration preference: fewer intolerable
+            # PreferNoSchedule taints score higher (k8s tainttoleration
+            # scorer, per-node form of its count-and-normalize reduce)
+            taint_score = 0.0
+            if node.node is not None:
+                intolerable = 0
+                for taint in node.node.taints or []:
+                    if taint.get("effect") != "PreferNoSchedule":
+                        continue
+                    if not taint_tolerated(taint, pod.tolerations or []):
+                        intolerable += 1
+                taint_score = 100.0 / (1.0 + intolerable)
+            # preferred inter-pod (anti-)affinity: weight per matching term
+            # against pods already on the node
+            pa_score = 0.0
+            if pod.affinity:
+                on_node = [t.pod for t in node.tasks.values()]
+                for kind, sign in (("podAffinity", 1.0),
+                                   ("podAntiAffinity", -1.0)):
+                    spec = (pod.affinity.get(kind) or {})
+                    for pref in spec.get(
+                            "preferredDuringSchedulingIgnoredDuringExecution",
+                            []):
+                        weight = pref.get("weight", 0)
+                        term = pref.get("podAffinityTerm") or {}
+                        sel = (term.get("labelSelector") or {}).get(
+                            "matchLabels", {})
+                        if any(all((p.labels or {}).get(k) == v
+                                   for k, v in sel.items())
+                               for p in on_node):
+                            pa_score += sign * weight
             return (self.least_requested * least
                     + self.most_requested * most
                     + self.balanced * balanced
-                    + self.node_affinity * affinity_score)
+                    + self.node_affinity * affinity_score
+                    + self.taint_toleration * taint_score
+                    + self.pod_affinity * pa_score)
 
         ssn.add_node_order_fn(self.name(), node_order_fn)
 

@@ -28,7 +28,12 @@ class Scheduler:
     def __init__(self, cache: SchedulerCache,
                  scheduler_conf: Optional[str] = None,
                  conf_path: Optional[str] = None,
-                 period: float = DEFAULT_SCHEDULE_PERIOD):
+                 period: float = DEFAULT_SCHEDULE_PERIOD,
+                 percentage_of_nodes_to_find: int = 100):
+        # adaptive host-loop node sampling knob, instance-scoped
+        # (cmd/scheduler/app/options/options.go:37-40)
+        from .utils import NodeSampler
+        self.node_sampler = NodeSampler(percentage_of_nodes_to_find)
         self.cache = cache
         self.period = period
         self.conf_path = conf_path
@@ -67,6 +72,7 @@ class Scheduler:
         t0 = time.perf_counter()
         self.load_conf()
         ssn = open_session(self.cache, self.tiers, self.configurations)
+        ssn.node_sampler = self.node_sampler
         try:
             for action in self.actions:
                 ta = time.perf_counter()

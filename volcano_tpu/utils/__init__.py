@@ -6,5 +6,5 @@ from .leader_election import (  # noqa: F401
 )
 from .priority_queue import PriorityQueue  # noqa: F401
 from .scheduler_helper import (  # noqa: F401
-    ResourceReservation, reservation, validate_victims,
+    NodeSampler, ResourceReservation, reservation, validate_victims,
 )
