@@ -150,3 +150,33 @@ class TestEffectors:
         # releasing resources counted in future-idle, not idle
         assert cache.nodes["n1"].idle.milli_cpu == 3000
         assert cache.nodes["n1"].future_idle().milli_cpu == 4000
+
+
+class TestAsyncEffectors:
+    def test_async_bind_fires_and_drains(self):
+        """cache.go:505-512 fires Bind in a goroutine; the async pool is
+        the equivalent, with wait_for_effects as the drain seam."""
+        from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+        from volcano_tpu.client import ClusterStore
+        from volcano_tpu.conf import PluginOption, Tier
+        from volcano_tpu.framework import (
+            close_session, get_action, open_session,
+        )
+        from helpers import build_node, build_pod, build_pod_group
+
+        store = ClusterStore()
+        cache = SchedulerCache(store, async_effectors=True)
+        cache.binder = FakeBinder()
+        cache.evictor = FakeEvictor()
+        cache.run()
+        store.create("nodes", build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        store.create("podgroups", build_pod_group("pg1", "c1", min_member=1))
+        store.create("pods", build_pod("c1", "p1", "", "Pending",
+                                       {"cpu": "1", "memory": "1Gi"}, "pg1"))
+        tiers = [Tier(plugins=[PluginOption(name="gang"),
+                               PluginOption(name="predicates")])]
+        ssn = open_session(cache, tiers)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        cache.wait_for_effects()
+        assert cache.binder.binds == {"c1/p1": "n1"}

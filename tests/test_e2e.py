@@ -399,3 +399,50 @@ class TestSchedulingActions:
         w.converge(cycles=6)
         assert all(not p.node_name for p in w.pods("claimer"))
         assert len([p for p in w.pods("greedy") if p.node_name]) == 4
+
+
+class TestStandalone:
+    def test_standalone_schedules_a_job(self):
+        """The single-process dev cluster (volcano_tpu.standalone): job
+        YAML in, pods created by the controllers, bound by the scheduler."""
+        from volcano_tpu.standalone import Standalone
+        from volcano_tpu.models import Node
+
+        sa = Standalone(period=0.01, metrics_port=0)
+        try:
+            sa.store.create("nodes", Node(
+                name="n1",
+                allocatable={"cpu": "4", "memory": "8Gi", "pods": "110"},
+                capacity={"cpu": "4", "memory": "8Gi", "pods": "110"}))
+            sa.apply_job_yaml("""
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata:
+  name: demo
+  namespace: default
+spec:
+  minAvailable: 2
+  tasks:
+  - name: worker
+    replicas: 2
+    template:
+      spec:
+        containers:
+        - name: c
+          requests:
+            cpu: "1"
+            memory: 1Gi
+""")
+            for _ in range(6):
+                sa.run_once()
+            pods = sa.store.list("pods", namespace="default")
+            assert len(pods) == 2
+            assert all(p.node_name == "n1" for p in pods)
+            # metrics endpoint is live
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{sa.metrics_server.port}/healthz",
+                    timeout=5) as r:
+                assert r.read() == b"ok\n"
+        finally:
+            sa.stop()

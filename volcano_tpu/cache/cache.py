@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from ..api import (
@@ -226,10 +227,19 @@ class SchedulerCache:
 
     def __init__(self, cluster: Optional[ClusterStore] = None,
                  scheduler_name: str = "volcano",
-                 default_queue: str = "default"):
+                 default_queue: str = "default",
+                 async_effectors: bool = False):
         self.cluster = cluster if cluster is not None else ClusterStore()
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
+        # async bind/evict dispatch (cache.go:505-512, 559-565 fire the API
+        # writes in goroutines with resync-on-failure). Off by default: the
+        # in-memory store makes synchronous effects deterministic for tests;
+        # turn on when effects go to a remote control plane.
+        self._effector_pool = (
+            ThreadPoolExecutor(max_workers=4, thread_name_prefix="effector")
+            if async_effectors else None)
+        self._pending_effects: List = []
 
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
@@ -473,6 +483,14 @@ class SchedulerCache:
     # -- snapshot (cache.go:670-748) ----------------------------------------
 
     def snapshot(self) -> ClusterInfo:
+        # Take the store's write lock for the whole clone: async effector
+        # threads mutate this cache via store listeners (which run under
+        # that lock), so holding it here is the SchedulerCache.Mutex of the
+        # reference (cache.go:72, Snapshot locks before cloning).
+        with self.cluster.locked():
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> ClusterInfo:
         drop = getattr(self.volume_binder, "drop_assumptions", None)
         if drop is not None:
             drop()  # assumptions are session-scoped
@@ -525,19 +543,21 @@ class SchedulerCache:
         except ValueError:
             job.update_task_status(task, original)
             raise
-        try:
-            self.binder.bind(task.pod, hostname)
-        except Exception:
-            log.exception("bind failed for %s", task.key)
-            metrics.schedule_attempts.inc(labels={"result": "error"})
-            self.resync_task(task)
-            return
-        metrics.schedule_attempts.inc(labels={"result": "scheduled"})
         start = (job.schedule_start_timestamp
                  or task.pod.creation_timestamp or 0.0)
-        if start:
-            metrics.task_scheduling_latency.observe(
-                (time.time() - start) * 1e3)
+
+        def effect():
+            self.binder.bind(task.pod, hostname)
+            metrics.schedule_attempts.inc(labels={"result": "scheduled"})
+            if start:
+                metrics.task_scheduling_latency.observe(
+                    (time.time() - start) * 1e3)
+
+        def failed():
+            metrics.schedule_attempts.inc(labels={"result": "error"})
+            self.resync_task(task)
+
+        self._dispatch_effect(effect, failed, f"bind {task.key}")
 
     def evict(self, ti: TaskInfo, reason: str) -> None:
         job, task = self._find_job_and_task(ti)
@@ -552,11 +572,36 @@ class SchedulerCache:
         except (ValueError, KeyError):
             job.update_task_status(task, original)
             raise
-        try:
-            self.evictor.evict(task.pod, reason)
-        except Exception:
-            log.exception("evict failed for %s", task.key)
-            self.resync_task(task)
+        self._dispatch_effect(
+            lambda: self.evictor.evict(task.pod, reason),
+            lambda: self.resync_task(task), f"evict {task.key}")
+
+    def _dispatch_effect(self, effect, failed, what: str) -> None:
+        """Run a side-effect against the control plane: inline by default,
+        in the effector pool when async (the reference's fire-and-forget
+        goroutines with rate-limited resync on failure)."""
+
+        def run():
+            try:
+                effect()
+            except Exception:
+                log.exception("%s failed", what)
+                failed()
+
+        if self._effector_pool is None:
+            run()
+        else:
+            # prune completed futures so long-running schedulers that never
+            # drain explicitly don't accumulate them without bound
+            self._pending_effects = [f for f in self._pending_effects
+                                     if not f.done()]
+            self._pending_effects.append(self._effector_pool.submit(run))
+
+    def wait_for_effects(self) -> None:
+        """Drain in-flight async effects (tests / clean shutdown)."""
+        pending, self._pending_effects = self._pending_effects, []
+        for fut in pending:
+            fut.result()
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         self.volume_binder.allocate_volumes(task, hostname)

@@ -46,15 +46,26 @@ def _key(obj) -> str:
 
 
 class ClusterStore:
-    """Typed object buckets + watch listeners. Single-threaded by design
-    (the host has one core; ordering is deterministic, which also makes the
-    informer-delta semantics testable)."""
+    """Typed object buckets + watch listeners. Writes serialize under one
+    reentrant lock: the normal control flow is single-threaded (ordering
+    deterministic, informer-delta semantics testable), but the job-updater
+    fan-out and async effectors may write concurrently — each write
+    (admission + mutation + listener delivery) is atomic under the lock,
+    like one API-server request."""
 
     def __init__(self):
+        import threading
         self._buckets: Dict[str, Dict[str, Any]] = {k: {} for k in KINDS}
         self._listeners: Dict[str, List[Listener]] = {k: [] for k in KINDS}
         self._interceptors: List[Interceptor] = []
+        self._lock = threading.RLock()
         self._rv = 0
+
+    def locked(self):
+        """The store's write lock, for callers that need a consistent
+        multi-read view against concurrent writers (e.g. the scheduler
+        cache's snapshot — the reference's SchedulerCache.Mutex)."""
+        return self._lock
 
     # -- admission ----------------------------------------------------------
 
@@ -71,10 +82,11 @@ class ClusterStore:
     def watch(self, kind: str, listener: Listener, replay: bool = True) -> None:
         """Subscribe to a bucket; replay=True delivers existing objects as
         adds first (informer list-then-watch semantics)."""
-        self._listeners[kind].append(listener)
-        if replay:
-            for obj in list(self._buckets[kind].values()):
-                listener("add", obj, None)
+        with self._lock:
+            self._listeners[kind].append(listener)
+            if replay:
+                for obj in list(self._buckets[kind].values()):
+                    listener("add", obj, None)
 
     def _notify(self, kind: str, event: str, obj, old=None) -> None:
         for fn in list(self._listeners[kind]):
@@ -83,67 +95,73 @@ class ClusterStore:
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, kind: str, obj):
-        obj = self._admit("create", kind, obj)
-        key = _key(obj)
-        bucket = self._buckets[kind]
-        if key in bucket:
-            raise ConflictError(f"{kind} {key} already exists")
-        self._rv += 1
-        if hasattr(obj, "resource_version"):
-            obj.resource_version = self._rv
-        bucket[key] = obj
-        self._notify(kind, "add", obj)
-        return obj
+        with self._lock:
+            obj = self._admit("create", kind, obj)
+            key = _key(obj)
+            bucket = self._buckets[kind]
+            if key in bucket:
+                raise ConflictError(f"{kind} {key} already exists")
+            self._rv += 1
+            if hasattr(obj, "resource_version"):
+                obj.resource_version = self._rv
+            bucket[key] = obj
+            self._notify(kind, "add", obj)
+            return obj
 
     def update(self, kind: str, obj):
-        obj = self._admit("update", kind, obj)
-        key = _key(obj)
-        bucket = self._buckets[kind]
-        old = bucket.get(key)
-        if old is None:
-            raise NotFoundError(f"{kind} {key} not found")
-        # Optimistic concurrency: a writer presenting a stale copy loses
-        # (k8s resourceVersion precondition). Only enforced when the caller
-        # hands in a *different* object carrying a version — in-place updates
-        # of the stored object (the informer-cache pattern) and fresh objects
-        # with version 0 carry no precondition.
-        if (obj is not old
-                and getattr(obj, "resource_version", 0)
-                and getattr(old, "resource_version", 0)
-                and obj.resource_version != old.resource_version):
-            raise ConflictError(
-                f"{kind} {key}: stale resource_version "
-                f"{obj.resource_version} != {old.resource_version}")
-        self._rv += 1
-        if hasattr(obj, "resource_version"):
-            obj.resource_version = self._rv
-        bucket[key] = obj
-        self._notify(kind, "update", obj, old)
-        return obj
+        with self._lock:
+            obj = self._admit("update", kind, obj)
+            key = _key(obj)
+            bucket = self._buckets[kind]
+            old = bucket.get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            # Optimistic concurrency: a writer presenting a stale copy
+            # loses (k8s resourceVersion precondition). Only enforced when
+            # the caller hands in a *different* object carrying a version —
+            # in-place updates of the stored object (the informer-cache
+            # pattern) and fresh objects with version 0 carry no
+            # precondition.
+            if (obj is not old
+                    and getattr(obj, "resource_version", 0)
+                    and getattr(old, "resource_version", 0)
+                    and obj.resource_version != old.resource_version):
+                raise ConflictError(
+                    f"{kind} {key}: stale resource_version "
+                    f"{obj.resource_version} != {old.resource_version}")
+            self._rv += 1
+            if hasattr(obj, "resource_version"):
+                obj.resource_version = self._rv
+            bucket[key] = obj
+            self._notify(kind, "update", obj, old)
+            return obj
 
     def apply(self, kind: str, obj):
         """Create-or-update."""
-        key = _key(obj)
-        if key in self._buckets[kind]:
-            return self.update(kind, obj)
-        return self.create(kind, obj)
+        with self._lock:
+            key = _key(obj)
+            if key in self._buckets[kind]:
+                return self.update(kind, obj)
+            return self.create(kind, obj)
 
     def delete(self, kind: str, name: str, namespace: Optional[str] = None):
-        key = f"{namespace}/{name}" if namespace is not None else name
-        bucket = self._buckets[kind]
-        obj = bucket.pop(key, None)
-        if obj is None:
-            raise NotFoundError(f"{kind} {key} not found")
-        self._admit("delete", kind, obj)
-        self._notify(kind, "delete", obj)
-        return obj
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace is not None else name
+            bucket = self._buckets[kind]
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._admit("delete", kind, obj)
+            self._notify(kind, "delete", obj)
+            return obj
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None):
-        key = f"{namespace}/{name}" if namespace is not None else name
-        obj = self._buckets[kind].get(key)
-        if obj is None:
-            raise NotFoundError(f"{kind} {key} not found")
-        return obj
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace is not None else name
+            obj = self._buckets[kind].get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            return obj
 
     def try_get(self, kind: str, name: str, namespace: Optional[str] = None):
         try:
@@ -155,7 +173,9 @@ class ClusterStore:
              label_selector: Optional[Dict[str, str]] = None,
              name_glob: Optional[str] = None) -> List[Any]:
         out = []
-        for obj in self._buckets[kind].values():
+        with self._lock:
+            objs = list(self._buckets[kind].values())
+        for obj in objs:
             if namespace is not None and getattr(obj, "namespace", None) != namespace:
                 continue
             if label_selector:

@@ -1,17 +1,35 @@
 """JobUpdater: push PodGroup status back on session close.
 
-Reference framework/job_updater.go:16-108 fans out over 16 workers and
-jitters duplicate condition updates; the TPU build is single-core so the
-update loop is sequential, with the same skip-if-unchanged dedup.
+Reference framework/job_updater.go:16-108 fans out over 16 workers with a
+skip-if-unchanged dedup. The fan-out matters when status writes go to a
+remote control plane (each write is a network round trip); against the
+in-memory store it degrades gracefully to near-sequential behind the
+store's lock.
 """
 
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 
 from .session import job_status
 
 log = logging.getLogger(__name__)
+
+#: jobUpdaterWorker (job_updater.go:17)
+JOB_UPDATER_WORKERS = 16
+
+#: lazily created persistent pool shared by all sessions (daemon threads;
+#: creating/joining 16 threads per session close would be pure churn)
+_POOL = None
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        _POOL = ThreadPoolExecutor(max_workers=JOB_UPDATER_WORKERS,
+                                   thread_name_prefix="job-updater")
+    return _POOL
 
 
 def _conditions_equal(c1, c2) -> bool:
@@ -31,12 +49,21 @@ def _status_equal(s1, s2) -> bool:
 
 
 class JobUpdater:
-    def __init__(self, ssn):
+    def __init__(self, ssn, workers: int = JOB_UPDATER_WORKERS):
         self.ssn = ssn
+        self.workers = workers
 
     def update_all(self) -> None:
-        for job in self.ssn.jobs.values():
-            self.update_job(job)
+        jobs = list(self.ssn.jobs.values())
+        # the fan-out only pays for many jobs against a slow control plane;
+        # small sessions stay sequential and deterministic
+        if len(jobs) <= 4 or self.workers <= 1:
+            for job in jobs:
+                self.update_job(job)
+            return
+        # consume the iterator so worker exceptions surface in the logs
+        # via update_job's own try/except, not silently in futures
+        list(_shared_pool().map(self.update_job, jobs))
 
     def update_job(self, job) -> None:
         if job.pod_group is None:

@@ -20,6 +20,12 @@ def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
     return tuple(sorted(labels.items()))
 
 
+#: one lock for all metric mutations: observations come from the scheduler
+#: thread, the async effector pool, and the job-updater fan-out; the
+#: read-modify-write ops below are not atomic under the GIL
+_metrics_lock = threading.Lock()
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: Iterable[str] = ()):
         self.name = name
@@ -34,7 +40,8 @@ class Counter(_Metric):
 
     def inc(self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None):
         k = _label_key(labels)
-        self._values[k] = self._values.get(k, 0.0) + amount
+        with _metrics_lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
 
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
@@ -80,6 +87,10 @@ class Histogram(_Metric):
 
     def observe(self, value: float, labels: Optional[Dict[str, str]] = None):
         k = _label_key(labels)
+        with _metrics_lock:
+            self._observe_locked(k, value)
+
+    def _observe_locked(self, k, value: float):
         counts = self._counts.setdefault(k, [0] * len(self.buckets))
         for i, b in enumerate(self.buckets):
             if value <= b:

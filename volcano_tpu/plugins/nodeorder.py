@@ -81,8 +81,18 @@ class NodeOrderPlugin(Plugin):
                         term = pref.get("podAffinityTerm") or {}
                         sel = (term.get("labelSelector") or {}).get(
                             "matchLabels", {})
-                        if any(all((p.labels or {}).get(k) == v
-                                   for k, v in sel.items())
+                        if not sel:
+                            # matchExpressions-only selectors are not
+                            # evaluated here; an empty matchLabels must not
+                            # match every pod
+                            continue
+                        # k8s scopes the term to its namespaces list, or
+                        # the incoming pod's namespace by default
+                        namespaces = set(term.get("namespaces")
+                                         or [pod.namespace])
+                        if any(p.namespace in namespaces
+                               and all((p.labels or {}).get(k) == v
+                                       for k, v in sel.items())
                                for p in on_node):
                             pa_score += sign * weight
             return (self.least_requested * least

@@ -1,0 +1,134 @@
+"""Standalone dev cluster: every component in one process.
+
+The reference deploys three binaries against a Kubernetes API server
+(installer/volcano-development.yaml). This module is the TPU build's
+single-process equivalent for development and e2e use: one ClusterStore
+plays the API server, and around it run
+
+- the admission chain (in-process interceptors + optional TLS server),
+- the controller manager (job/queue/podgroup/kubelet-standin/gc),
+- the scheduler loop (solver on the local chip or via the solver sidecar),
+- the metrics endpoint (/metrics, /healthz, /debug/stacks).
+
+``python -m volcano_tpu.standalone [--conf scheduler.yaml] [--period 1.0]
+[--serve-webhooks] [--sidecar /path/to.sock] [--metrics-port 8080]``
+
+Jobs are submitted with the in-process CLI against the same store when
+embedding, or by pointing --jobs-dir at a directory of job YAMLs (each
+file is applied once; the reference's e2e suites submit via vcctl).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class Standalone:
+    def __init__(self, scheduler_conf: Optional[str] = None,
+                 period: float = 1.0, serve_webhooks_tls: bool = False,
+                 sidecar_path: Optional[str] = None,
+                 metrics_port: int = 0,
+                 async_effectors: bool = True):
+        from .cache import SchedulerCache
+        from .client import ClusterStore
+        from .controllers import ControllerManager
+        from .metrics.server import MetricsServer
+        from .scheduler import Scheduler
+        from .webhooks import start_webhooks
+
+        self.store = ClusterStore()
+        start_webhooks(self.store)
+        self.webhook_server = None
+        if serve_webhooks_tls:
+            from .webhooks import serve_webhooks
+            self.webhook_server = serve_webhooks(self.store)
+            self.webhook_server.start_background()
+        self.cache = SchedulerCache(self.store,
+                                    async_effectors=async_effectors)
+        if sidecar_path:
+            from .parallel.sidecar import SidecarSolver
+            self.cache.sidecar = SidecarSolver(sidecar_path)
+        self.cache.run()
+        self.controllers = ControllerManager(self.store)
+        self.controllers.run()
+        self.scheduler = Scheduler(self.cache, scheduler_conf=scheduler_conf,
+                                   period=period)
+        self.metrics_server = MetricsServer(port=metrics_port).start()
+        self._stop = threading.Event()
+
+    def run_once(self) -> None:
+        """One control-plane turn: controllers drain, scheduler cycles."""
+        self.controllers.process_all()
+        self.scheduler.run_once()
+        self.controllers.process_all()
+        self.cache.wait_for_effects()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.time()
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("control-plane turn failed")
+            delay = self.scheduler.period - (time.time() - t0)
+            if delay > 0:
+                self._stop.wait(delay)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.metrics_server.stop()
+        if self.webhook_server is not None:
+            self.webhook_server.shutdown()
+
+    def apply_job_yaml(self, text: str) -> None:
+        import yaml
+
+        from .cli.vcctl import _job_from_yaml
+
+        self.store.create("jobs", _job_from_yaml(yaml.safe_load(text)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="volcano-tpu-standalone")
+    ap.add_argument("--conf", help="scheduler conf YAML path")
+    ap.add_argument("--period", type=float, default=1.0)
+    ap.add_argument("--serve-webhooks", action="store_true",
+                    help="also serve admission over TLS")
+    ap.add_argument("--sidecar", help="solver sidecar socket path")
+    ap.add_argument("--metrics-port", type=int, default=8080)
+    ap.add_argument("--jobs-dir", help="apply every .yaml job in this dir")
+    args = ap.parse_args(argv)
+
+    conf = None
+    if args.conf:
+        with open(args.conf) as f:
+            conf = f.read()
+    sa = Standalone(scheduler_conf=conf, period=args.period,
+                    serve_webhooks_tls=args.serve_webhooks,
+                    sidecar_path=args.sidecar,
+                    metrics_port=args.metrics_port)
+    if args.jobs_dir:
+        import glob
+        import os
+        for path in sorted(glob.glob(os.path.join(args.jobs_dir, "*.yaml"))):
+            with open(path) as f:
+                sa.apply_job_yaml(f.read())
+    print(f"volcano-tpu standalone up; metrics on "
+          f":{sa.metrics_server.port}", flush=True)
+    try:
+        sa.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sa.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

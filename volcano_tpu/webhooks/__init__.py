@@ -25,3 +25,15 @@ def start_webhooks(cluster, scheduler_name: str = "volcano") -> WebhookManager:
     wm = WebhookManager(cluster, scheduler_name)
     wm.run()
     return wm
+
+
+def serve_webhooks(cluster, host: str = "127.0.0.1", port: int = 0,
+                   cert_path=None, key_path=None):
+    """Register all admission services and serve them over TLS (the
+    reference's webhook-manager deployment shape). Returns the server;
+    call .start_background() or .serve_forever()."""
+    from .server import AdmissionServer
+
+    register_all()
+    return AdmissionServer(cluster, host=host, port=port,
+                           cert_path=cert_path, key_path=key_path)
