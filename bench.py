@@ -280,7 +280,7 @@ def config4_preempt():
     from volcano_tpu.api.types import POD_GROUP_ANNOTATION
     from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
     from volcano_tpu.ops import bucket, flatten_snapshot
-    from volcano_tpu.ops.evict import solve_evict
+    from volcano_tpu.ops.evict import solve_evict_uniform
 
     n_nodes, n_running, n_claim = 200, 2000, 1000
     nodes = {}
@@ -332,19 +332,27 @@ def config4_preempt():
     elig[0, :len(ordered)] = True  # priority tier: all lower-prio victims
     need = np.zeros(J, np.int32)
     need[0] = n_claim
+    # the uniform gang fast path (solve_evict_uniform): one step per job
+    job_req = np.zeros((J, arr.R), np.float32)
+    job_req[0] = arr.task_init_req[0]
+    job_count = np.zeros(J, np.int32)
+    job_count[0] = n_claim
     varrays = {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
-               "elig": elig, "job_need": need}
+               "elig": elig, "job_need": need,
+               "job_req": job_req, "job_count": job_count}
 
     import jax
 
     d = {k: jax.device_put(v) for k, v in arr.device_dict().items()}
     v = {k: jax.device_put(np.asarray(val)) for k, val in varrays.items()}
-    res = solve_evict(d, v, params)  # compile
-    res.assigned.block_until_ready()
+    from volcano_tpu.ops.evict import decode_evict_compact
+
+    res = solve_evict_uniform(d, v, params)  # compile
+    res.compact.block_until_ready()
     t0 = time.perf_counter()
-    res = solve_evict(d, v, params)
-    assigned = np.asarray(res.assigned)
-    evicted = np.asarray(res.evicted_by)
+    res = solve_evict_uniform(d, v, params)
+    assigned, evicted = decode_evict_compact(
+        res.compact, d["task_init_req"].shape[0])
     dt = (time.perf_counter() - t0) * 1e3
     return {
         "running": n_running, "claimers": n_claim, "nodes": n_nodes,

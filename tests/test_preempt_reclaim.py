@@ -154,6 +154,34 @@ class TestGangPreempt:
         assert ssn.jobs["c1/high"].waiting_task_num() == 3
         close_session(ssn)
 
+    def test_nonuniform_gang_uses_scan_kernel(self, mode):
+        # mixed task sizes disqualify the per-job closed-form fast path;
+        # the scan kernel must produce the same gang preemption
+        low_pg = build_pod_group("low", "c1", min_member=1)
+        high_pg = build_pod_group("high", "c1", min_member=2)
+        high_pg.spec.priority_class_name = "high-priority"
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "4", "memory": "8Gi"})],
+            [low_pg, high_pg],
+            [build_pod("c1", f"low-{i}", "n1", "Running",
+                       {"cpu": "1", "memory": "1Gi"}, "low")
+             for i in range(4)]
+            + [build_pod("c1", "high-big", "", "Pending",
+                         {"cpu": "2", "memory": "1Gi"}, "high"),
+               build_pod("c1", "high-small", "", "Pending",
+                         {"cpu": "1", "memory": "1Gi"}, "high")],
+            priority_classes=[PriorityClass("high-priority", 1000)])
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang"),
+                               PluginOption(name="conformance")]),
+                 Tier(plugins=[PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_mode(cache, tiers, mode)
+        get_action("preempt").execute(ssn)
+        assert len(cache.evictor.evicts) == 3  # 3 cpu freed for 2+1
+        assert ssn.jobs["c1/high"].waiting_task_num() == 2
+        close_session(ssn)
+
     def test_gang_unsatisfiable_reverts_all_evictions(self, mode):
         # high gang of 5 can never fit 2x2-CPU nodes: NOTHING may be evicted
         store, cache, ssn = self._cluster(2, 2, 5, mode)

@@ -138,14 +138,35 @@ def build_victim_arrays(ssn, arr, victims, job_order, mode: str) -> Dict:
             "elig": elig, "job_need": need}
 
 
-def _evictions_by_task(evicted_by: np.ndarray) -> Dict[int, List[int]]:
-    """task index -> victim indices in victim-sorted (cheapest-first)
-    order."""
+def _evictions_by_job(evicted_by: np.ndarray) -> Dict[int, List[int]]:
+    """claimer job index -> victim indices in victim-sorted
+    (cheapest-first) order."""
     out: Dict[int, List[int]] = {}
-    for vi, ti in enumerate(evicted_by):
-        if ti >= 0:
-            out.setdefault(int(ti), []).append(vi)
+    for vi, ji in enumerate(evicted_by):
+        if ji >= 0:
+            out.setdefault(int(ji), []).append(vi)
     return out
+
+
+def _uniform_job_arrays(arr, job_order):
+    """(job_req [J,R], job_count [J]) when every claimer job's pending
+    tasks share one request vector and signature, else None (the per-job
+    closed-form kernel requires uniformity)."""
+    J = arr.job_min.shape[0]
+    job_req = np.zeros((J, arr.R), dtype=np.float32)
+    job_count = np.zeros(J, dtype=np.int32)
+    off = 0
+    for j, (_job, tasks) in enumerate(job_order):
+        k = len(tasks)
+        block = arr.task_init_req[off:off + k]
+        sigs = arr.task_sig[off:off + k]
+        if k > 1 and (not (block == block[0]).all()
+                      or not (sigs == sigs[0]).all()):
+            return None
+        job_req[j] = block[0]
+        job_count[j] = k
+        off += k
+    return job_req, job_count
 
 
 def run_evict_solver(ssn, mode: str):
@@ -173,19 +194,52 @@ def run_evict_solver(ssn, mode: str):
     varrays = build_victim_arrays(ssn, arr, victims, job_order, mode)
     params, families = build_score_inputs(ssn, arr)
 
-    res = solve_evict(
-        arr.device_dict(), {k: np.asarray(v) for k, v in varrays.items()},
-        params, score_families=families,
-        require_freed_covers=not preempt,
-        allow_revert=preempt, stop_at_need=preempt)
-    assigned = np.asarray(res.assigned)
-    evicted_by = np.asarray(res.evicted_by)
-    by_task = _evictions_by_task(evicted_by)
+    uniform = _uniform_job_arrays(arr, job_order)
+    if uniform is not None:
+        # gang fast path: one solve step per JOB (see solve_evict_uniform)
+        from ..ops.evict import solve_evict_uniform
+        varrays["job_req"], varrays["job_count"] = uniform
+        res = solve_evict_uniform(
+            arr.device_dict(),
+            {k: np.asarray(v) for k, v in varrays.items()},
+            params, score_families=families,
+            require_freed_covers=not preempt, stop_at_need=preempt)
+    else:
+        res = solve_evict(
+            arr.device_dict(),
+            {k: np.asarray(v) for k, v in varrays.items()},
+            params, score_families=families,
+            require_freed_covers=not preempt,
+            allow_revert=preempt, stop_at_need=preempt)
+    from ..ops.evict import decode_evict_compact
+    try:
+        # one int16 readback carries both outputs (remote-chip wire cost)
+        assigned, evicted_by = decode_evict_compact(
+            res.compact, arr.task_init_req.shape[0])
+    except ValueError:  # >32k nodes/jobs: indices overflow the packing
+        assigned = np.asarray(res.assigned)
+        evicted_by = np.asarray(res.evicted_by)
+    by_job = _evictions_by_job(evicted_by)
 
     from ..metrics import metrics
     idx = 0
-    for job, tasks in job_order:
+    for j, (job, tasks) in enumerate(job_order):
         stmt = ssn.statement() if preempt else None
+        evs = by_job.get(j, ())
+        # the job's evictions land first (cheapest-first order), then its
+        # claimers pipeline — one Statement per job like the host loop's
+        # per-preemptor statements rolled up. Per-victim try: one failing
+        # eviction must not skip the rest (the pipelines would otherwise
+        # land on capacity that was never freed)
+        for vi in evs:
+            try:
+                if preempt:
+                    stmt.evict(victims[vi], "preempt")
+                else:
+                    ssn.evict(victims[vi], "reclaim")
+            except (KeyError, ValueError):
+                log.exception("%s eviction replay failed for %s",
+                              mode, victims[vi].key)
         for task in tasks:
             t_idx = idx
             idx += 1
@@ -194,11 +248,6 @@ def run_evict_solver(ssn, mode: str):
                 continue
             node_name = arr.nodes_list[node_idx].name
             try:
-                for vi in by_task.get(t_idx, ()):
-                    if preempt:
-                        stmt.evict(victims[vi], "preempt")
-                    else:
-                        ssn.evict(victims[vi], "reclaim")
                 if preempt:
                     stmt.pipeline(task, node_name)
                     metrics.preemption_attempts.inc()
@@ -207,9 +256,7 @@ def run_evict_solver(ssn, mode: str):
             except (KeyError, ValueError):
                 log.exception("%s replay failed for %s", mode, task.key)
         if preempt:
-            metrics.preemption_victims.set(
-                sum(len(by_task.get(i, ()))
-                    for i in range(t_idx - len(tasks) + 1, t_idx + 1)))
+            metrics.preemption_victims.set(len(evs))
             if ssn.job_pipelined(job):
                 stmt.commit()
             else:

@@ -29,13 +29,39 @@ from typing import Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .solver import NEG, _segment_prefix, le_fits, score_matrix
+from .solver import (
+    COMPACT_UNAVAILABLE, NEG, _segment_prefix, le_fits, score_matrix,
+)
 
 
 class EvictResult(NamedTuple):
     assigned: jnp.ndarray    # [T] int32: node index the task pipelines on, or -1
-    evicted_by: jnp.ndarray  # [V] int32: preemptor task index, or -1
+    evicted_by: jnp.ndarray  # [V] int32: claimer JOB index, or -1
     job_placed: jnp.ndarray  # [J] int32: pipelined placements per job
+    compact: jnp.ndarray = None  # [T+V] int16: assigned ++ evicted_by —
+                                 # one readback instead of two round trips;
+                                 # sentinel-filled when indices overflow
+
+
+def _evict_compact(assigned, evby, n_nodes: int, n_jobs: int):
+    if max(n_nodes, n_jobs) >= (1 << 15):
+        # indices don't fit int16: sentinel so decode fails loudly
+        return jnp.full(assigned.shape[0] + evby.shape[0],
+                        COMPACT_UNAVAILABLE, jnp.int16)
+    return jnp.concatenate([assigned, evby]).astype(jnp.int16)
+
+
+def decode_evict_compact(compact, n_tasks: int):
+    """host-side: compact int16 -> (assigned [T], evicted_by [V]) int32.
+    Raises when the solve emitted the overflow sentinel — read
+    res.assigned / res.evicted_by instead."""
+    import numpy as np
+    c = np.asarray(compact).astype(np.int32)
+    if c.size and c[0] == COMPACT_UNAVAILABLE:
+        raise ValueError(
+            "compact evict result unavailable (node/job count exceeds the "
+            "int16 packing); read res.assigned / res.evicted_by instead")
+    return c[:n_tasks], c[n_tasks:]
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -152,7 +178,7 @@ def solve_evict(arrays: Dict[str, jnp.ndarray],
         delta = jnp.where(got, freed - a["task_req"][i], 0.0)
         future = future.at[c].add(delta)
         alive = alive & ~ev
-        evby = jnp.where(ev, i, evby)
+        evby = jnp.where(ev, jidx, evby)
         assigned = assigned.at[i].set(jnp.where(got, choice, -1))
         jalloc = jalloc.at[jidx].add(got.astype(jnp.int32))
         return (future, alive, evby, assigned, jalloc, cur_job,
@@ -170,4 +196,147 @@ def solve_evict(arrays: Dict[str, jnp.ndarray],
     future, alive, evby, assigned, jalloc = finalize(
         (future, alive, evby, assigned, jalloc,
          s_future, s_alive, s_evby, s_assigned), cur_job)
-    return EvictResult(assigned=assigned, evicted_by=evby, job_placed=jalloc)
+    return EvictResult(assigned=assigned, evicted_by=evby, job_placed=jalloc,
+                       compact=_evict_compact(assigned, evby, N,
+                                              need.shape[0]))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "score_families", "require_freed_covers", "stop_at_need"))
+def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
+                        victims: Dict[str, jnp.ndarray],
+                        score_params: Dict[str, jnp.ndarray],
+                        score_families: Tuple[str, ...] = ("kube",),
+                        require_freed_covers: bool = False,
+                        stop_at_need: bool = True) -> EvictResult:
+    """Per-JOB closed-form eviction solve for uniform claimers.
+
+    When every pending claimer of a job has the same request (the gang
+    case — BASELINE config #4 is one 1k-task gang), the whole job places
+    in one step: per node, the number of claimers it can absorb is
+    floor((future + total-freeable) / request); claimers spread across
+    nodes in score order; the minimal cheapest-first victim prefix covering
+    each node's count is evicted. Gang all-or-nothing is exact — a job
+    whose total placeable count misses its need places (and evicts)
+    NOTHING, so no revert pass exists. O(jobs) scan steps instead of
+    O(claimers), ~60x fewer for config #4.
+
+    victims: as solve_evict, plus job_req [J,R] (the per-job uniform
+    request) and job_count [J] (pending claimers per job).
+    """
+    a = arrays
+    v_req = victims["v_req"]
+    v_node = victims["v_node"]
+    v_valid = victims["v_valid"]
+    elig = victims["elig"]
+    need = victims["job_need"]
+    job_req = victims["job_req"]          # [J,R]
+    job_count = victims["job_count"]      # [J]
+    T = a["task_init_req"].shape[0]
+    N = a["node_idle"].shape[0]
+    V = v_req.shape[0]
+    J = a["job_min"].shape[0]
+    thr = a["thresholds"]
+    sm = a["scalar_dim_mask"]
+    future0 = a["node_idle"] + a["node_extra_future"]
+    score_all = score_matrix(a["task_init_req"], future0, a["node_used"],
+                             a["node_alloc"], score_params, score_families)
+    seg_start = jnp.concatenate(
+        [jnp.array([True]), v_node[1:] != v_node[:-1]])
+    vidx = jnp.arange(V)
+    # per-job node feasibility mask (claimers of one job share a signature
+    # in the uniform case; take the AND over the job's tasks to stay safe)
+    sig_feas_t = a["sig_masks"][a["task_sig"]] | ~a["task_valid"][:, None]
+    job_feas = jnp.ones((J, N), jnp.int32).at[a["task_job"]].min(
+        sig_feas_t.astype(jnp.int32)) > 0
+    # representative score row per job: first task (rank order) of the job
+    first_task = jnp.full((J,), T - 1, jnp.int32).at[
+        a["task_job"]].min(jnp.arange(T, dtype=jnp.int32))
+    job_score = score_all[first_task]                              # [J,N]
+    # position of each task within its job (contiguous grouping)
+    task_pos = jnp.arange(T, dtype=jnp.int32) - first_task[a["task_job"]]
+
+    def step(carry, j):
+        future, alive, evby, assigned, jalloc = carry
+        r = job_req[j]                                             # [R]
+        # per-dim significance mirrors le_fits' per-task rule: scalar dims
+        # requesting <= 10 milli are ignored for FIT (r_fit zeroed) but
+        # still debited for accounting, like the per-task kernel
+        sig = jnp.where(sm, r > 10.0, r > 0.0)                     # [R]
+        r_fit = jnp.where(sig, r, 0.0)
+        count = (jnp.minimum(job_count[j], need[j]) if stop_at_need
+                 else job_count[j])
+        active = a["job_valid"][j] & (count > 0)
+
+        elig_v = elig[j] & alive & v_valid
+        vreq_m = v_req * elig_v[:, None]
+        prefix_incl = _segment_prefix(vreq_m, seg_start) + vreq_m  # [V,R]
+        ptot = jax.ops.segment_sum(vreq_m, v_node, num_segments=N)  # [N,R]
+        has_v = jax.ops.segment_max(
+            elig_v.astype(jnp.int32), v_node, num_segments=N) > 0
+        # max claimers node n can absorb with ALL its eligible victims
+        # freed: largest m with m*r fitting future+ptot (threshold-eased)
+        base = jnp.zeros_like(future) if require_freed_covers else future
+        avail = base + ptot                                        # [N,R]
+        per_dim = jnp.where(
+            sig[None, :],
+            jnp.floor((avail + thr[None, :]) / jnp.maximum(r, 1e-9)),
+            jnp.inf)
+        m = jnp.min(per_dim, axis=1)                               # [N]
+        m = jnp.clip(jnp.nan_to_num(m, posinf=float(T)), 0.0, float(T))
+        m = jnp.where(job_feas[j] & a["node_valid"] & has_v, m, 0.0)
+        m = m.astype(jnp.int32)
+
+        total = jnp.sum(m)
+        # gang: need `need[j]` pipelines; if unreachable place nothing
+        satisfied = (total >= need[j]) if stop_at_need else jnp.bool_(True)
+        do = active & satisfied & (total > 0)
+        count = jnp.where(do, jnp.minimum(count, total), 0)
+
+        # spread claimers over nodes in score order
+        order = jnp.argsort(-jnp.where(m > 0, job_score[j], NEG))  # [N]
+        m_o = m[order]
+        cum = jnp.cumsum(m_o)
+        prev = cum - m_o
+        c_o = jnp.clip(count - prev, 0, m_o)                       # [N]
+        c = jnp.zeros(N, jnp.int32).at[order].set(c_o)             # [N]
+
+        # task -> node: claimer position p lands on the node where the
+        # score-ordered cumulative count first exceeds p
+        is_mine = (a["task_job"] == j) & a["task_valid"]
+        p = task_pos
+        node_for_p = order[jnp.clip(
+            jnp.searchsorted(cum, p.astype(cum.dtype), side="right"),
+            0, N - 1)]
+        placed_t = is_mine & (p < count)
+        assigned = jnp.where(placed_t, node_for_p.astype(jnp.int32),
+                             assigned)
+
+        # minimal victim prefix per node covering c_n * r beyond future.
+        # demand_fit drops the insignificant dims (same rule as `m` above,
+        # else cut could stay V and mass-evict); accounting uses full r
+        demand_fit = c.astype(jnp.float32)[:, None] * r_fit[None, :]
+        demand_acct = c.astype(jnp.float32)[:, None] * r[None, :]
+        fit_now_n = le_fits(demand_fit, base, thr, sm,
+                            ignore_req=demand_fit)
+        need_evict_n = (c > 0) & ~fit_now_n
+        fit_at = le_fits(demand_fit[v_node], base[v_node] + prefix_incl,
+                         thr, sm, ignore_req=demand_fit[v_node]) & elig_v
+        cut = jax.ops.segment_min(jnp.where(fit_at, vidx, V), v_node,
+                                  num_segments=N)
+        ev = elig_v & need_evict_n[v_node] & (vidx <= cut[v_node])
+        freed = jax.ops.segment_sum(v_req * ev[:, None], v_node,
+                                    num_segments=N)
+        future = future + freed - demand_acct
+        alive = alive & ~ev
+        evby = jnp.where(ev, j, evby)
+        jalloc = jalloc.at[j].add(count)
+        return (future, alive, evby, assigned, jalloc), None
+
+    init = (future0, v_valid, jnp.full((V,), -1, jnp.int32),
+            jnp.full((T,), -1, jnp.int32), jnp.zeros(J, jnp.int32))
+    carry, _ = jax.lax.scan(step, init, jnp.arange(J))
+    future, alive, evby, assigned, jalloc = carry
+    return EvictResult(assigned=assigned, evicted_by=evby,
+                       job_placed=jalloc,
+                       compact=_evict_compact(assigned, evby, N, J))
