@@ -335,11 +335,13 @@ def config4_preempt():
     # the uniform gang fast path (solve_evict_uniform): one step per job
     job_req = np.zeros((J, arr.R), np.float32)
     job_req[0] = arr.task_init_req[0]
+    job_acct = np.zeros((J, arr.R), np.float32)
+    job_acct[0] = arr.task_req[0]
     job_count = np.zeros(J, np.int32)
     job_count[0] = n_claim
     varrays = {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
-               "elig": elig, "job_need": need,
-               "job_req": job_req, "job_count": job_count}
+               "elig": elig, "job_need": need, "job_req": job_req,
+               "job_acct": job_acct, "job_count": job_count}
 
     import jax
 

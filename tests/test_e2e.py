@@ -446,3 +446,111 @@ spec:
                 assert r.read() == b"ok\n"
         finally:
             sa.stop()
+
+
+class TestJobResilience:
+    """More jobseq/jobp parity: task restart, retry exhaustion, scale,
+    pod-loss recovery, CLI + admission end-to-end."""
+
+    def test_restart_task_syncs_without_job_restart(self):
+        """RestartTask is a valid policy action that resolves to Sync in
+        this reference version (actions.go:31 calls it the 'default
+        action'; syncJob's pod diff keeps Failed pods,
+        job_controller_actions.go:269-285): the job must NOT restart
+        (retry_count 0, survivors untouched) and the failed pod is counted
+        failed rather than recreated."""
+        w = World()
+        w.store.create("jobs", make_job(min_available=1, task_policies=[
+            LifecyclePolicy(event=Event.POD_FAILED,
+                            action=Action.RESTART_TASK)]))
+        w.converge()
+        survivor = w.pods("job1")[1].name
+        w.fail_pod(w.pods("job1")[0])
+        w.converge()
+        assert w.phase() == JobPhase.RUNNING
+        assert w.job().status.retry_count == 0
+        assert w.job().status.failed == 1
+        pods = w.pods("job1")
+        assert len(pods) == 2  # failed pod kept, not recreated
+        assert survivor in {p.name for p in pods if p.phase == "Running"}
+
+    def test_max_retry_exhaustion_fails_job(self):
+        """RestartJob fires at most spec.maxRetry times; the job then goes
+        Failed (state/restarting.go + job.go MaxRetry default)."""
+        w = World()
+        job = make_job(policies=[
+            LifecyclePolicy(event=Event.POD_FAILED,
+                            action=Action.RESTART_JOB)])
+        job.spec.max_retry = 2
+        w.store.create("jobs", job)
+        w.converge()
+        for _ in range(4):
+            pods = [p for p in w.pods("job1") if p.phase == "Running"]
+            if not pods:
+                break
+            w.fail_pod(pods[0])
+            w.converge(cycles=4)
+        assert w.phase() == JobPhase.FAILED
+        assert w.job().status.retry_count >= 2
+
+    def test_scale_down_then_up(self):
+        """Replica updates (the only mutable job fields, admit_job.go:
+        199-237) diff pods: scale down deletes, scale up creates."""
+        w = World()
+        w.store.create("jobs", make_job(replicas=3, min_available=1))
+        w.converge()
+        assert len(w.pods("job1")) == 3
+        job = w.job()
+        job.spec.tasks[0].replicas = 1
+        w.store.update("jobs", job)
+        w.converge()
+        w.kubelet_finalize()
+        w.converge()
+        live = [p for p in w.pods("job1") if p.deletion_timestamp is None]
+        assert len(live) == 1
+        job = w.job()
+        job.spec.tasks[0].replicas = 2
+        w.store.update("jobs", job)
+        w.converge()
+        live = [p for p in w.pods("job1") if p.deletion_timestamp is None]
+        assert len(live) == 2
+        assert all(p.phase == "Running" for p in live)
+
+    def test_deleted_pod_recreated(self):
+        """Losing a pod out-of-band resyncs the job (OutOfSync -> Sync)
+        and the controller recreates it."""
+        w = World()
+        w.store.create("jobs", make_job(min_available=1))
+        w.converge()
+        victim = w.pods("job1")[0]
+        w.store.delete("pods", victim.name, victim.namespace)
+        w.converge()
+        pods = w.pods("job1")
+        assert len(pods) == 2
+        assert all(p.phase == "Running" for p in pods)
+
+    def test_cli_submit_schedules(self):
+        """vcctl job run -> admission defaults -> controllers -> scheduler
+        (the jobp CLI e2e path)."""
+        from volcano_tpu.cli.vcctl import main as vcctl
+
+        w = World()
+        out = vcctl(["job", "run", "--name", "cli-job", "--replicas", "2",
+                     "--min-available", "2", "--requests",
+                     "cpu=1,memory=1Gi"], cluster=w.store)
+        assert "created" in out.lower() or "cli-job" in out
+        w.converge()
+        assert w.phase("cli-job") == JobPhase.RUNNING
+        assert all(p.phase == "Running" for p in w.pods("cli-job"))
+        listed = vcctl(["vjobs"], cluster=w.store)
+        assert "cli-job" in listed
+
+    def test_admission_denies_bad_job_in_world(self):
+        """The interceptor chain guards the store end-to-end."""
+        from volcano_tpu.client.store import AdmissionError
+
+        w = World()
+        bad = make_job(name="badjob", replicas=2, min_available=5)
+        with pytest.raises(AdmissionError):
+            w.store.create("jobs", bad)
+        assert w.store.try_get("jobs", "badjob", "default") is None

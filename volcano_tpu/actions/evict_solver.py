@@ -149,24 +149,29 @@ def _evictions_by_job(evicted_by: np.ndarray) -> Dict[int, List[int]]:
 
 
 def _uniform_job_arrays(arr, job_order):
-    """(job_req [J,R], job_count [J]) when every claimer job's pending
-    tasks share one request vector and signature, else None (the per-job
-    closed-form kernel requires uniformity)."""
+    """(job_req, job_acct [J,R], job_count [J]) when every claimer job's
+    pending tasks share one fit request, one accounting request, and one
+    signature, else None (the per-job closed-form kernel requires
+    uniformity)."""
     J = arr.job_min.shape[0]
     job_req = np.zeros((J, arr.R), dtype=np.float32)
+    job_acct = np.zeros((J, arr.R), dtype=np.float32)
     job_count = np.zeros(J, dtype=np.int32)
     off = 0
     for j, (_job, tasks) in enumerate(job_order):
         k = len(tasks)
-        block = arr.task_init_req[off:off + k]
+        fit = arr.task_init_req[off:off + k]
+        acct = arr.task_req[off:off + k]
         sigs = arr.task_sig[off:off + k]
-        if k > 1 and (not (block == block[0]).all()
+        if k > 1 and (not (fit == fit[0]).all()
+                      or not (acct == acct[0]).all()
                       or not (sigs == sigs[0]).all()):
             return None
-        job_req[j] = block[0]
+        job_req[j] = fit[0]
+        job_acct[j] = acct[0]
         job_count[j] = k
         off += k
-    return job_req, job_count
+    return job_req, job_acct, job_count
 
 
 def run_evict_solver(ssn, mode: str):
@@ -194,16 +199,19 @@ def run_evict_solver(ssn, mode: str):
     varrays = build_victim_arrays(ssn, arr, victims, job_order, mode)
     params, families = build_score_inputs(ssn, arr)
 
-    uniform = _uniform_job_arrays(arr, job_order)
+    # the closed-form kernel is preempt-only: reclaim's per-claimer victim
+    # coverage rule is not a per-node divisibility (see solve_evict_uniform)
+    uniform = _uniform_job_arrays(arr, job_order) if preempt else None
     if uniform is not None:
         # gang fast path: one solve step per JOB (see solve_evict_uniform)
         from ..ops.evict import solve_evict_uniform
-        varrays["job_req"], varrays["job_count"] = uniform
+        (varrays["job_req"], varrays["job_acct"],
+         varrays["job_count"]) = uniform
         res = solve_evict_uniform(
             arr.device_dict(),
             {k: np.asarray(v) for k, v in varrays.items()},
             params, score_families=families,
-            require_freed_covers=not preempt, stop_at_need=preempt)
+            require_freed_covers=False, stop_at_need=True)
     else:
         res = solve_evict(
             arr.device_dict(),

@@ -214,15 +214,23 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
     When every pending claimer of a job has the same request (the gang
     case — BASELINE config #4 is one 1k-task gang), the whole job places
     in one step: per node, the number of claimers it can absorb is
-    floor((future + total-freeable) / request); claimers spread across
-    nodes in score order; the minimal cheapest-first victim prefix covering
-    each node's count is evicted. Gang all-or-nothing is exact — a job
-    whose total placeable count misses its need places (and evicts)
-    NOTHING, so no revert pass exists. O(jobs) scan steps instead of
-    O(claimers), ~60x fewer for config #4.
+    floor((future + total-freeable) / request) — computed against plain
+    avail (no threshold easing) with a one-step float-rounding backoff, so
+    the chosen count always fits and a victim cut always exists; claimers
+    spread across nodes in score order; the minimal cheapest-first victim
+    prefix covering each node's count is evicted. Gang all-or-nothing is
+    exact — a job whose total placeable count misses its need places (and
+    evicts) NOTHING, so no revert pass exists. O(jobs) scan steps instead
+    of O(claimers), ~60x fewer for config #4.
 
-    victims: as solve_evict, plus job_req [J,R] (the per-job uniform
-    request) and job_count [J] (pending claimers per job).
+    PREEMPT ONLY: reclaim's per-claimer coverage rule (each reclaimer's
+    own victim prefix must cover its full request, reclaim.go:91-101) is
+    not a per-node divisibility, so reclaim stays on the per-task scan
+    kernel (require_freed_covers is accepted for kernel-level tests only).
+
+    victims: as solve_evict, plus job_req [J,R] (the per-job uniform FIT
+    request / init_resreq), job_acct [J,R] (the uniform accounting resreq
+    debited from future, node_info.go AddTask), and job_count [J].
     """
     a = arrays
     v_req = victims["v_req"]
@@ -230,7 +238,8 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
     v_valid = victims["v_valid"]
     elig = victims["elig"]
     need = victims["job_need"]
-    job_req = victims["job_req"]          # [J,R]
+    job_req = victims["job_req"]          # [J,R] fit request
+    job_acct = victims["job_acct"]        # [J,R] accounting request
     job_count = victims["job_count"]      # [J]
     T = a["task_init_req"].shape[0]
     N = a["node_idle"].shape[0]
@@ -239,7 +248,8 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
     thr = a["thresholds"]
     sm = a["scalar_dim_mask"]
     future0 = a["node_idle"] + a["node_extra_future"]
-    score_all = score_matrix(a["task_init_req"], future0, a["node_used"],
+    # requests are uniform per job: score [J,N] directly instead of [T,N]
+    job_score = score_matrix(job_req, future0, a["node_used"],
                              a["node_alloc"], score_params, score_families)
     seg_start = jnp.concatenate(
         [jnp.array([True]), v_node[1:] != v_node[:-1]])
@@ -249,11 +259,9 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
     sig_feas_t = a["sig_masks"][a["task_sig"]] | ~a["task_valid"][:, None]
     job_feas = jnp.ones((J, N), jnp.int32).at[a["task_job"]].min(
         sig_feas_t.astype(jnp.int32)) > 0
-    # representative score row per job: first task (rank order) of the job
+    # position of each task within its job (contiguous grouping)
     first_task = jnp.full((J,), T - 1, jnp.int32).at[
         a["task_job"]].min(jnp.arange(T, dtype=jnp.int32))
-    job_score = score_all[first_task]                              # [J,N]
-    # position of each task within its job (contiguous grouping)
     task_pos = jnp.arange(T, dtype=jnp.int32) - first_task[a["task_job"]]
 
     def step(carry, j):
@@ -278,12 +286,21 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
         # freed: largest m with m*r fitting future+ptot (threshold-eased)
         base = jnp.zeros_like(future) if require_freed_covers else future
         avail = base + ptot                                        # [N,R]
+        # conservative count: m*r <= avail per significant dim guarantees
+        # le_fits passes (its "<= avail" disjunct), so the chosen count
+        # always fits and a victim cut always exists. No +thr easing here
+        # — that could admit an m whose demand then fails the fit check.
         per_dim = jnp.where(
             sig[None, :],
-            jnp.floor((avail + thr[None, :]) / jnp.maximum(r, 1e-9)),
+            jnp.floor(avail / jnp.maximum(r, 1e-9)),
             jnp.inf)
         m = jnp.min(per_dim, axis=1)                               # [N]
         m = jnp.clip(jnp.nan_to_num(m, posinf=float(T)), 0.0, float(T))
+        # one-step backoff for float division rounding up across an
+        # integer boundary (floor(a/r)*r marginally > a)
+        over = jnp.any((m[:, None] * r_fit[None, :]) > avail + 1e-3,
+                       axis=1)
+        m = jnp.where(over, jnp.maximum(m - 1.0, 0.0), m)
         m = jnp.where(job_feas[j] & a["node_valid"] & has_v, m, 0.0)
         m = m.astype(jnp.int32)
 
@@ -314,9 +331,12 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
 
         # minimal victim prefix per node covering c_n * r beyond future.
         # demand_fit drops the insignificant dims (same rule as `m` above,
-        # else cut could stay V and mass-evict); accounting uses full r
+        # else cut could stay V and mass-evict); accounting debits the
+        # RUNNING request (node_info.go AddTask subtracts Resreq), like
+        # the per-task kernel's `freed - task_req[i]`
         demand_fit = c.astype(jnp.float32)[:, None] * r_fit[None, :]
-        demand_acct = c.astype(jnp.float32)[:, None] * r[None, :]
+        demand_acct = (c.astype(jnp.float32)[:, None]
+                       * job_acct[j][None, :])
         fit_now_n = le_fits(demand_fit, base, thr, sm,
                             ignore_req=demand_fit)
         need_evict_n = (c > 0) & ~fit_now_n
@@ -324,7 +344,10 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
                          thr, sm, ignore_req=demand_fit[v_node]) & elig_v
         cut = jax.ops.segment_min(jnp.where(fit_at, vidx, V), v_node,
                                   num_segments=N)
-        ev = elig_v & need_evict_n[v_node] & (vidx <= cut[v_node])
+        # cut < V is guaranteed by the conservative m; the guard keeps a
+        # never-satisfiable fit from mass-evicting the whole node
+        ev = (elig_v & need_evict_n[v_node] & (vidx <= cut[v_node])
+              & (cut[v_node] < V))
         freed = jax.ops.segment_sum(v_req * ev[:, None], v_node,
                                     num_segments=N)
         future = future + freed - demand_acct
