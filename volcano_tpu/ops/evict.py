@@ -286,21 +286,27 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
         # freed: largest m with m*r fitting future+ptot (threshold-eased)
         base = jnp.zeros_like(future) if require_freed_covers else future
         avail = base + ptot                                        # [N,R]
-        # conservative count: m*r <= avail per significant dim guarantees
-        # le_fits passes (its "<= avail" disjunct), so the chosen count
-        # always fits and a victim cut always exists. No +thr easing here
-        # — that could admit an m whose demand then fails the fit check.
+        # conservative count: start from floor(avail / r) over requested
+        # dims (no +thr easing — that could admit an m whose demand then
+        # fails the fit check), then VALIDATE the candidate with le_fits
+        # itself so every dim rule matches exactly — zero-request
+        # non-scalar dims with negative avail zero the node out, and a
+        # float-division round-up backs off one step. The chosen count
+        # therefore always fits and a victim cut always exists.
         per_dim = jnp.where(
             sig[None, :],
             jnp.floor(avail / jnp.maximum(r, 1e-9)),
             jnp.inf)
         m = jnp.min(per_dim, axis=1)                               # [N]
         m = jnp.clip(jnp.nan_to_num(m, posinf=float(T)), 0.0, float(T))
-        # one-step backoff for float division rounding up across an
-        # integer boundary (floor(a/r)*r marginally > a)
-        over = jnp.any((m[:, None] * r_fit[None, :]) > avail + 1e-3,
-                       axis=1)
-        m = jnp.where(over, jnp.maximum(m - 1.0, 0.0), m)
+
+        def fits_m(mm):
+            return le_fits(mm[:, None] * r_fit[None, :], avail, thr, sm,
+                           ignore_req=r[None, :])
+
+        m_back = jnp.maximum(m - 1.0, 0.0)
+        m = jnp.where(fits_m(m), m,
+                      jnp.where(fits_m(m_back), m_back, 0.0))
         m = jnp.where(job_feas[j] & a["node_valid"] & has_v, m, 0.0)
         m = m.astype(jnp.int32)
 
