@@ -191,7 +191,7 @@ def headline():
     r = one_session(jobs_s, tasks_s, grouped_s)
     r.compact.block_until_ready()
     arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
-                           queues=queues)
+                           queues=queues, grouped=grouped_s)
     fill_queue_demand(arr, jobs_s, demand_cache)
     fbuf, ibuf, layout = arr.packed()
     f2d, i2d = dcache.update(fbuf, ibuf, layout)

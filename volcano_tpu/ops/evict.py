@@ -213,12 +213,12 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
 
     When every pending claimer of a job has the same request (the gang
     case — BASELINE config #4 is one 1k-task gang), the whole job places
-    in one step: per node, the number of claimers it can absorb is
-    floor((future + total-freeable) / request) — computed against plain
-    avail (no threshold easing) with a one-step float-rounding backoff, so
-    the chosen count always fits and a victim cut always exists; claimers
-    spread across nodes in score order; the minimal cheapest-first victim
-    prefix covering each node's count is evicted. Gang all-or-nothing is
+    in one step: per node, the candidate count floor((future +
+    total-freeable) / request) is validated by le_fits itself (one-step
+    backoff, zero fallback — the same rule as every other fit check, so
+    the chosen count always fits and a victim cut always exists);
+    claimers spread across nodes in score order; the minimal
+    cheapest-first victim prefix covering each node's count is evicted. Gang all-or-nothing is
     exact — a job whose total placeable count misses its need places (and
     evicts) NOTHING, so no revert pass exists. O(jobs) scan steps instead
     of O(claimers), ~60x fewer for config #4.
