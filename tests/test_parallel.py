@@ -70,3 +70,40 @@ class TestShardedSolver:
         assigned = np.asarray(res.assigned)[:16]
         counts = np.bincount(assigned[assigned >= 0], minlength=arr.N)
         assert counts[:8].max() == 2  # 16 tasks striped over 8 nodes
+
+    def test_queue_caps_match_single_chip(self, mesh):
+        """In-kernel proportional fair share on the mesh: a 3:1 weight
+        split of a saturated 8-cpu cluster yields 6:2, identical to the
+        single-device kernel (deserved is water-filled from a psum'd
+        cluster total; queue bookkeeping is replicated)."""
+        nodes = {f"n{i}": NodeInfo(build_node(
+            f"n{i}", {"cpu": "1", "memory": "100Gi"})) for i in range(8)}
+        jobs, tasks = {}, []
+        for q, jname in (("q1", "jA"), ("q2", "jB")):
+            pg = build_pod_group(jname, "ns", min_member=1, queue=q)
+            job = JobInfo(f"ns/{jname}", pg)
+            for i in range(8):
+                p = build_pod("ns", f"{jname}-{i}", "", "Pending",
+                              {"cpu": "1", "memory": "1Gi"}, jname)
+                t = TaskInfo(p)
+                job.add_task_info(t)
+                tasks.append(t)
+            jobs[job.uid] = job
+        from types import SimpleNamespace
+        queues = {"q1": SimpleNamespace(weight=3, capability=None),
+                  "q2": SimpleNamespace(weight=1, capability=None)}
+        arr = flatten_snapshot(jobs, nodes, tasks, queues=queues)
+        arr.fill_queue_demand()
+        p = params_dict(arr, least_req_weight=1.0)
+        single = solve_allocate(arr.device_dict(), p, herd_mode="spread",
+                                score_families=("kube",),
+                                use_queue_cap=True)
+        sharded = solve_allocate_sharded(arr.device_dict(), p, mesh,
+                                         herd_mode="spread",
+                                         score_families=("kube",),
+                                         use_queue_cap=True)
+        for res in (single, sharded):
+            a = np.asarray(res.assigned)
+            placed_q1 = int((a[:8] >= 0).sum())
+            placed_q2 = int((a[8:16] >= 0).sum())
+            assert (placed_q1, placed_q2) == (6, 2), (placed_q1, placed_q2)

@@ -235,6 +235,16 @@ class SnapshotArrays:
         ibuf = np.concatenate(iparts) if iparts else np.zeros(0, np.int32)
         return fbuf, ibuf, tuple(layout)
 
+    def fill_queue_demand(self) -> None:
+        """Fill queue_request from the flattened jobs' total requests — a
+        stand-in for the proportion plugin's session-open attrs when no
+        session is in the loop (benches, dryruns, kernel-level tests).
+        The allocate action overwrites these from the plugin instead."""
+        self.queue_request[:] = 0.0
+        for j, job in enumerate(self.jobs_list):
+            self.queue_request[self.job_queue[j]] += \
+                job.total_request.to_vector(self.vocab)
+
     def device_dict(self) -> Dict[str, np.ndarray]:
         """The arrays the solver kernel consumes (one host->device hop)."""
         return {

@@ -218,10 +218,11 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
     backoff, zero fallback — the same rule as every other fit check, so
     the chosen count always fits and a victim cut always exists);
     claimers spread across nodes in score order; the minimal
-    cheapest-first victim prefix covering each node's count is evicted. Gang all-or-nothing is
-    exact — a job whose total placeable count misses its need places (and
-    evicts) NOTHING, so no revert pass exists. O(jobs) scan steps instead
-    of O(claimers), ~60x fewer for config #4.
+    cheapest-first victim prefix covering each node's count is evicted.
+    Gang all-or-nothing is exact — a job whose total placeable count
+    misses its need places (and evicts) NOTHING, so no revert pass
+    exists. O(jobs) scan steps instead of O(claimers), ~60x fewer for
+    config #4.
 
     PREEMPT ONLY: reclaim's per-claimer coverage rule (each reclaimer's
     own victim prefix must cover its full request, reclaim.go:91-101) is

@@ -190,6 +190,24 @@ def water_fill_deserved(total, weight, cap, request, thr, max_iters: int):
     return deserved
 
 
+def queue_cap_state(a, rank, thr, total):
+    """Shared prelude for in-kernel queue fair share (used by the
+    single-device and mesh-sharded solvers — only the cluster `total`
+    source differs): water-filled deserved, the task->queue map, and the
+    static (queue, rank) sort for per-round prefix caps."""
+    q = a["queue_weight"].shape[0]
+    deserved = water_fill_deserved(
+        total, a["queue_weight"], a["queue_capability"],
+        a["queue_request"], thr, max_iters=q + 1)
+    task_queue = a["job_queue"][a["task_job"]]
+    t = task_queue.shape[0]
+    q_perm = jnp.argsort(task_queue * (t + 1) + rank)
+    s_q = task_queue[q_perm]
+    q_seg_start = jnp.concatenate(
+        [jnp.array([True]), s_q[1:] != s_q[:-1]])
+    return q, deserved, task_queue, q_perm, q_seg_start
+
+
 def _queue_cap_mask(eligible, task_queue, req, qrem, thr, scalar_mask,
                     q_perm, q_seg_start):
     """Per-round queue admission cap: among eligible tasks in (queue, rank)
@@ -370,20 +388,12 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
 
     if use_queue_cap:
-        Q = a["queue_weight"].shape[0]
         total = jnp.sum(
             a["node_alloc"] * a["node_valid"][:, None].astype(jnp.float32),
             axis=0)
-        deserved = water_fill_deserved(
-            total, a["queue_weight"], a["queue_capability"],
-            a["queue_request"], thr, max_iters=Q + 1)
-        task_queue = a["job_queue"][a["task_job"]]
+        Q, deserved, task_queue, q_perm, q_seg_start = queue_cap_state(
+            a, rank, thr, total)
         qalloc0 = a["queue_allocated"]
-        # static (queue, rank) order for the per-round queue-cap prefixes
-        q_perm = jnp.argsort(task_queue * (T + 1) + rank)
-        s_q = task_queue[q_perm]
-        q_seg_start = jnp.concatenate(
-            [jnp.array([True]), s_q[1:] != s_q[:-1]])
     else:
         task_queue = None
         deserved = None

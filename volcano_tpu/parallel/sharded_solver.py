@@ -33,8 +33,8 @@ except ImportError:  # older jax: experimental API, check_rep spelling
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.solver import (
-    NEG, BIG_KEY, SolveResult, _segment_prefix, fits_matrix, le_fits,
-    score_matrix,
+    NEG, BIG_KEY, SolveResult, _queue_cap_mask, _segment_prefix,
+    fits_matrix, le_fits, queue_cap_state, score_matrix,
 )
 
 
@@ -52,14 +52,16 @@ def make_mesh(devices=None, axis: str = "n") -> Mesh:
 
 @functools.partial(jax.jit, static_argnames=("mesh", "max_rounds",
                                              "max_gang_iters", "herd_mode",
-                                             "score_families"))
+                                             "score_families",
+                                             "use_queue_cap"))
 def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            score_params: Dict[str, jnp.ndarray],
                            mesh: Mesh,
                            max_rounds: int = 64,
                            max_gang_iters: int = 8,
                            herd_mode: str = "pack",
-                           score_families: Tuple[str, ...] = ("binpack",)) -> SolveResult:
+                           score_families: Tuple[str, ...] = ("binpack",),
+                           use_queue_cap: bool = False) -> SolveResult:
     a = arrays
     T = a["task_init_req"].shape[0]
     N = a["node_idle"].shape[0]
@@ -81,6 +83,12 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         "node_npods": P("n"), "node_max_pods": P("n"), "node_valid": P("n"),
         "sig_masks": P(None, "n"), "thresholds": P(), "scalar_dim_mask": P(),
     }
+    if use_queue_cap:
+        # queue state is tiny and fairness is a global property: replicate
+        # it and keep every device's bookkeeping identical (the only
+        # cross-device input is the cluster-total capacity, one psum)
+        in_specs.update({"queue_weight": P(), "queue_capability": P(),
+                         "queue_allocated": P(), "queue_request": P()})
     params_spec = {k: (P("n") if k == "node_static" else P())
                    for k in score_params}
 
@@ -89,6 +97,17 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         n_loc = a["node_idle"].shape[0]
         my_base = axis_idx * n_loc
         sig_feas = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
+
+        if use_queue_cap:
+            total = jax.lax.psum(
+                jnp.sum(a["node_alloc"]
+                        * a["node_valid"][:, None].astype(jnp.float32),
+                        axis=0), "n")
+            Q, deserved, task_queue, q_perm, q_seg_start = queue_cap_state(
+                a, rank, thr, total)
+            qalloc0 = a["queue_allocated"]
+        else:
+            qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
         def choose(eligible, avail, idle, npods):
             """Global choice per task: local scoring + cross-device argmax,
@@ -195,34 +214,48 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 return s[-1] & (s[-2] < max_rounds)
 
             def body(s):
-                idle, pipe, npods, assigned, kind, excluded, rounds, _ = s
+                (idle, pipe, npods, qalloc, assigned, kind, excluded,
+                 rounds, _) = s
                 avail = (idle + a["node_extra_future"] - pipe) if use_future \
                     else idle
                 eligible = (a["task_valid"] & (assigned < 0)
                             & ~excluded[a["task_job"]])
+                if use_queue_cap:
+                    qrem = jnp.maximum(deserved - qalloc, 0.0)
+                    eligible = eligible & _queue_cap_mask(
+                        eligible, task_queue, a["task_req"], qrem, thr,
+                        scalar_mask, q_perm, q_seg_start)
                 choice, feas = choose(eligible, avail, idle, npods)
                 new_assign, debit, pod_inc = admit_local(
                     choice, feas, avail, npods)
                 got = new_assign >= 0
                 assigned = jnp.where(got, new_assign, assigned)
                 kind = jnp.where(got, jnp.int32(1 if use_future else 0), kind)
+                if use_queue_cap:
+                    # got is replicated (pmax in admit_local), so every
+                    # device books identical queue allocations
+                    qalloc = qalloc + jax.ops.segment_sum(
+                        a["task_req"] * got[:, None], task_queue,
+                        num_segments=Q)
                 if use_future:
                     pipe = pipe + debit
                 else:
                     idle = idle - debit
                     npods = npods + pod_inc
-                return (idle, pipe, npods, assigned, kind, excluded,
+                return (idle, pipe, npods, qalloc, assigned, kind, excluded,
                         rounds + 1, jnp.any(got))
 
             out = jax.lax.while_loop(cond, body, st + (jnp.bool_(True),))
             return out[:-1]
 
         def gang_body(s):
-            idle, pipe, npods, assigned, kind, excluded, rounds, _, it = s
-            st = (idle, pipe, npods, assigned, kind, excluded, rounds)
+            (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
+             _, it, reverted_once) = s
+            st = (idle, pipe, npods, qalloc, assigned, kind, excluded,
+                  rounds)
             st = phase_rounds(st, False)
             st = phase_rounds(st, True)
-            idle, pipe, npods, assigned, kind, excluded, rounds = st
+            idle, pipe, npods, qalloc, assigned, kind, excluded, rounds = st
             alloc_counts = jax.ops.segment_sum(
                 ((assigned >= 0) & (kind == 0)).astype(jnp.int32)
                 * counts_ready, a["task_job"], num_segments=J)
@@ -245,19 +278,29 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 num_segments=n_loc)
             idle = idle + credit
             npods = npods - pod_credit
+            if use_queue_cap:
+                qalloc = qalloc - jax.ops.segment_sum(
+                    a["task_req"] * revert_task[:, None], task_queue,
+                    num_segments=Q)
             assigned = jnp.where(revert_task, -1, assigned)
             kind = jnp.where(revert_task, -1, kind)
-            excluded = excluded | revert_job
-            return (idle, pipe, npods, assigned, kind, excluded, rounds,
-                    jnp.any(revert_job), it + 1)
+            # one retry per job before permanent exclusion, matching the
+            # single-device gang fixpoint (ops/solver.py gang_body)
+            excluded = excluded | (revert_job & reverted_once)
+            reverted_once = reverted_once | revert_job
+            return (idle, pipe, npods, qalloc, assigned, kind, excluded,
+                    rounds, jnp.any(revert_job), it + 1, reverted_once)
 
         init = (a["node_idle"], jnp.zeros_like(a["node_idle"]),
-                a["node_npods"], jnp.full((T,), -1, jnp.int32),
+                a["node_npods"], qalloc0,
+                jnp.full((T,), -1, jnp.int32),
                 jnp.full((T,), -1, jnp.int32), ~a["job_valid"],
-                jnp.int32(0), jnp.bool_(True), jnp.int32(0))
+                jnp.int32(0), jnp.bool_(True), jnp.int32(0),
+                jnp.zeros(J, dtype=bool))
         s = jax.lax.while_loop(
-            lambda s: s[-2] & (s[-1] < max_gang_iters), gang_body, init)
-        idle, pipe, npods, assigned, kind, excluded, rounds, _, _ = s
+            lambda s: s[-3] & (s[-2] < max_gang_iters), gang_body, init)
+        (idle, pipe, npods, _, assigned, kind, excluded, rounds,
+         _, _, _) = s
         alloc_counts = jax.ops.segment_sum(
             ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
             a["task_job"], num_segments=J)
