@@ -65,6 +65,44 @@ def test_roundtrip_matches_local_solve(sidecar):
     assert info2["shipped_chunks"] == 0
 
 
+def test_preempt_action_through_sidecar(sidecar):
+    """Eviction solves ship over the socket too: a high-priority gang
+    preempts via the sidecar's solve_evict op."""
+    from volcano_tpu.models import PriorityClass
+
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.sidecar = sidecar
+    cache.device_cache = None  # no in-process fallback
+    cache.run()
+    store.create("priorityclasses", PriorityClass("high", 1000))
+    store.create("nodes", build_node("n1", {"cpu": "2", "memory": "4Gi"}))
+    low = build_pod_group("low", "c1", min_member=1)
+    high = build_pod_group("high", "c1", min_member=1)
+    high.spec.priority_class_name = "high"
+    store.create("podgroups", low)
+    store.create("podgroups", high)
+    for i in (1, 2):
+        store.create("pods", build_pod(
+            "c1", f"low-{i}", "n1", "Running",
+            {"cpu": "1", "memory": "1Gi"}, "low"))
+    store.create("pods", build_pod(
+        "c1", "high-1", "", "Pending",
+        {"cpu": "1", "memory": "1Gi"}, "high"))
+    tiers = [Tier(plugins=[PluginOption(name="priority"),
+                           PluginOption(name="gang"),
+                           PluginOption(name="conformance")]),
+             Tier(plugins=[PluginOption(name="predicates"),
+                           PluginOption(name="nodeorder")])]
+    ssn = open_session(cache, tiers)
+    get_action("preempt").execute(ssn)
+    close_session(ssn)
+    assert len(cache.evictor.evicts) == 1
+    assert cache.evictor.evicts[0].startswith("c1/low")
+
+
 def test_allocate_action_through_sidecar(sidecar):
     store = ClusterStore()
     cache = SchedulerCache(store)

@@ -203,30 +203,37 @@ def run_evict_solver(ssn, mode: str):
     # coverage rule is not a per-node divisibility (see solve_evict_uniform)
     uniform = _uniform_job_arrays(arr, job_order) if preempt else None
     if uniform is not None:
-        # gang fast path: one solve step per JOB (see solve_evict_uniform)
-        from ..ops.evict import solve_evict_uniform
         (varrays["job_req"], varrays["job_acct"],
          varrays["job_count"]) = uniform
-        res = solve_evict_uniform(
-            arr.device_dict(),
-            {k: np.asarray(v) for k, v in varrays.items()},
-            params, score_families=families,
-            require_freed_covers=False, stop_at_need=True)
-    else:
-        res = solve_evict(
-            arr.device_dict(),
-            {k: np.asarray(v) for k, v in varrays.items()},
-            params, score_families=families,
+    vnp = {k: np.asarray(v) for k, v in varrays.items()}
+    sidecar = getattr(ssn, "sidecar", None)
+    if sidecar is not None:
+        # process boundary: evict solves ship to the solver process too
+        # (presence of job_req in the victim dict selects the fast path)
+        assigned, evicted_by = sidecar.solve_evict(
+            arr.device_dict(), vnp, params, score_families=families,
             require_freed_covers=not preempt,
             allow_revert=preempt, stop_at_need=preempt)
-    from ..ops.evict import decode_evict_compact
-    try:
-        # one int16 readback carries both outputs (remote-chip wire cost)
-        assigned, evicted_by = decode_evict_compact(
-            res.compact, arr.task_init_req.shape[0])
-    except ValueError:  # >32k nodes/jobs: indices overflow the packing
-        assigned = np.asarray(res.assigned)
-        evicted_by = np.asarray(res.evicted_by)
+    else:
+        if uniform is not None:
+            # gang fast path: one solve step per JOB (solve_evict_uniform)
+            from ..ops.evict import solve_evict_uniform
+            res = solve_evict_uniform(
+                arr.device_dict(), vnp, params, score_families=families,
+                require_freed_covers=False, stop_at_need=True)
+        else:
+            res = solve_evict(
+                arr.device_dict(), vnp, params, score_families=families,
+                require_freed_covers=not preempt,
+                allow_revert=preempt, stop_at_need=preempt)
+        from ..ops.evict import decode_evict_compact
+        try:
+            # one int16 readback carries both outputs (remote-chip wire)
+            assigned, evicted_by = decode_evict_compact(
+                res.compact, arr.task_init_req.shape[0])
+        except ValueError:  # >32k nodes/jobs: indices overflow the packing
+            assigned = np.asarray(res.assigned)
+            evicted_by = np.asarray(res.evicted_by)
     by_job = _evictions_by_job(evicted_by)
 
     from ..metrics import metrics

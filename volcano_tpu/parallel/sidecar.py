@@ -117,6 +117,32 @@ class SolverServer:
                 "shipped_chunks": dcache.last_shipped_chunks}, \
             [np.asarray(res.assigned), np.asarray(res.kind)]
 
+    def _solve_evict(self, header, blobs):
+        """Eviction solve: arrays/victims/params arrive as named blobs;
+        the uniform fast path is chosen when the victim dict carries
+        job_req/job_acct/job_count (the client's uniformity verdict)."""
+        from ..ops.evict import solve_evict, solve_evict_uniform
+
+        names = header["blob_names"]
+        arrays, victims, params = {}, {}, {}
+        for name, blob in zip(names, blobs):
+            group, key = name.split(".", 1)
+            val = blob if blob.ndim else np.float32(blob)
+            {"a": arrays, "v": victims, "p": params}[group][key] = val
+        families = tuple(header["score_families"])
+        if "job_req" in victims:
+            res = solve_evict_uniform(
+                arrays, victims, params, score_families=families,
+                require_freed_covers=header["require_freed_covers"],
+                stop_at_need=header["stop_at_need"])
+        else:
+            res = solve_evict(
+                arrays, victims, params, score_families=families,
+                require_freed_covers=header["require_freed_covers"],
+                allow_revert=header["allow_revert"],
+                stop_at_need=header["stop_at_need"])
+        return {}, [np.asarray(res.assigned), np.asarray(res.evicted_by)]
+
     def serve_forever(self) -> None:
         try:
             os.unlink(self.path)
@@ -138,8 +164,12 @@ class SolverServer:
                             self._stop.set()
                             return
                         try:
-                            out_header, out_blobs = self._solve(header,
-                                                                blobs)
+                            if header.get("op") == "solve_evict":
+                                out_header, out_blobs = self._solve_evict(
+                                    header, blobs)
+                            else:
+                                out_header, out_blobs = self._solve(header,
+                                                                    blobs)
                         except Exception as e:  # noqa: BLE001
                             # a bad request must not kill the server or
                             # leave the client hanging: answer with an
@@ -187,6 +217,19 @@ class SidecarSolver:
         _send_frame(sock, {"op": "shutdown"}, [])
         self.close()
 
+    def _request(self, header, blobs):
+        try:
+            sock = self._connect()
+            _send_frame(sock, header, blobs)
+            out_header, out_blobs = _recv_frame(sock)
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+        if "error" in out_header:
+            raise RuntimeError(
+                f"sidecar {header.get('op')} failed: {out_header['error']}")
+        return out_header, out_blobs
+
     def solve(self, fbuf, ibuf, layout, params,
               herd_mode: str = "pack",
               score_families: Tuple[str, ...] = ("binpack",),
@@ -204,17 +247,37 @@ class SidecarSolver:
             "score_families": list(score_families),
             "use_queue_cap": bool(use_queue_cap),
         }
-        try:
-            sock = self._connect()
-            _send_frame(sock, header, blobs)
-            out_header, out_blobs = _recv_frame(sock)
-        except (ConnectionError, OSError):
-            self.close()
-            raise
-        if "error" in out_header:
-            raise RuntimeError(
-                f"sidecar solve failed: {out_header['error']}")
+        out_header, out_blobs = self._request(header, blobs)
         return out_blobs[0], out_blobs[1], out_header
+
+    def solve_evict(self, arrays, victims, params,
+                    score_families: Tuple[str, ...] = ("kube",),
+                    require_freed_covers: bool = False,
+                    allow_revert: bool = True,
+                    stop_at_need: bool = True):
+        """Eviction solve over the socket (preempt/reclaim). Returns
+        (assigned [T] int32, evicted_by [V] int32).
+
+        Arrays ship as raw named blobs, unlike allocate's delta-cached
+        packed buffers: the sidecar sits next to its chip (unix socket +
+        local PCIe/ICI), evict runs only when preempt/reclaim are
+        configured, and its flatten has a different task set per call —
+        a second delta cache would mostly thrash."""
+        names, blobs = [], []
+        for group, d in (("a", arrays), ("v", victims), ("p", params)):
+            for key, val in d.items():
+                names.append(f"{group}.{key}")
+                blobs.append(np.asarray(val))
+        header = {
+            "op": "solve_evict",
+            "blob_names": names,
+            "score_families": list(score_families),
+            "require_freed_covers": bool(require_freed_covers),
+            "allow_revert": bool(allow_revert),
+            "stop_at_need": bool(stop_at_need),
+        }
+        _, out_blobs = self._request(header, blobs)
+        return out_blobs[0], out_blobs[1]
 
 
 def main(argv=None) -> int:
