@@ -554,3 +554,75 @@ class TestJobResilience:
         with pytest.raises(AdmissionError):
             w.store.create("jobs", bad)
         assert w.store.try_get("jobs", "badjob", "default") is None
+
+
+class TestSoak:
+    def test_churn_soak_stays_bounded(self):
+        """Jobs stream in, run, complete, and are TTL-collected over many
+        control-plane turns; stores and caches must return to baseline
+        (no leaked pods/podgroups/configmaps, flatten cache swept, no
+        stale volume assumptions)."""
+        import time as _time
+
+        from volcano_tpu.standalone import Standalone
+        from volcano_tpu.models import Node
+
+        sa = Standalone(period=0.01, metrics_port=0, async_effectors=False)
+        try:
+            for n in range(4):
+                sa.store.create("nodes", Node(
+                    name=f"n{n}",
+                    allocatable={"cpu": "8", "memory": "16Gi", "pods": "110"},
+                    capacity={"cpu": "8", "memory": "16Gi", "pods": "110"}))
+            from volcano_tpu.controllers.garbagecollector import (
+                GarbageCollector,
+            )
+            gc = next(c for c in sa.controllers.controllers
+                      if isinstance(c, GarbageCollector))
+            for wave in range(10):
+                for k in range(3):
+                    sa.apply_job_yaml(f"""
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata:
+  name: wave{wave}-j{k}
+  namespace: default
+spec:
+  minAvailable: 2
+  ttlSecondsAfterFinished: 0
+  plugins:
+    svc: []
+  tasks:
+  - name: w
+    replicas: 2
+    template:
+      spec:
+        containers:
+        - name: c
+          requests:
+            cpu: "1"
+            memory: 1Gi
+""")
+                for _ in range(3):
+                    sa.run_once()
+                # jobs of this wave ran; complete their pods
+                for p in sa.store.list("pods"):
+                    if p.phase == "Running":
+                        p.phase = "Succeeded"
+                        sa.store.update("pods", p)
+                sa.run_once()
+                gc.process_all(now=_time.time() + 1)  # ttl=0: collect now
+                sa.run_once()
+            # steady state: everything collected
+            assert sa.store.list("jobs") == []
+            assert sa.store.list("pods") == []
+            assert sa.store.list("podgroups") == []
+            assert sa.store.list("configmaps") == []
+            assert sa.store.list("networkpolicies") == []
+            # caches bounded: flatten cache swept of departed jobs, no
+            # stale volume assumptions, no leaked effector futures
+            assert len(sa.cache.flatten_cache.job_blocks) <= 70
+            assert sa.cache.volume_binder._assumed == {}
+            assert len(sa.cache._pending_effects) <= 8
+        finally:
+            sa.stop()

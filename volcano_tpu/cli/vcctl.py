@@ -75,6 +75,23 @@ def job_run(args, cluster: ClusterStore) -> str:
     return f"run job {job.name} successfully"
 
 
+def _policies_from_yaml(raw_policies) -> list:
+    from ..models import Event, LifecyclePolicy
+
+    out = []
+    for p in raw_policies or []:
+        exit_code = p.get("exitCode")
+        timeout = p.get("timeout")
+        out.append(LifecyclePolicy(
+            action=Action(p["action"]) if p.get("action") else Action.SYNC_JOB,
+            event=Event(p["event"]) if p.get("event") else None,
+            events=[Event(e) for e in p.get("events", [])],
+            exit_code=int(exit_code) if exit_code is not None else None,
+            timeout_seconds=float(timeout) if timeout is not None else None,
+        ))
+    return out
+
+
 def _job_from_yaml(raw: dict) -> Job:
     meta = raw.get("metadata", {})
     spec = raw.get("spec", {})
@@ -82,7 +99,11 @@ def _job_from_yaml(raw: dict) -> Job:
     for t in spec.get("tasks", []):
         tasks.append(TaskSpec(name=t.get("name", ""),
                               replicas=int(t.get("replicas", 1)),
-                              template=t.get("template", {})))
+                              template=t.get("template", {}),
+                              policies=_policies_from_yaml(t.get("policies"))))
+    kw = {}
+    if spec.get("maxRetry") is not None:
+        kw["max_retry"] = int(spec["maxRetry"])
     return Job(
         name=meta.get("name", "job"),
         namespace=meta.get("namespace", "default"),
@@ -92,6 +113,13 @@ def _job_from_yaml(raw: dict) -> Job:
             scheduler_name=spec.get("schedulerName", "volcano"),
             tasks=tasks,
             plugins=spec.get("plugins", {}) or {},
+            policies=_policies_from_yaml(spec.get("policies")),
+            priority_class_name=spec.get("priorityClassName", ""),
+            ttl_seconds_after_finished=(
+                int(spec["ttlSecondsAfterFinished"])
+                if spec.get("ttlSecondsAfterFinished") is not None else None),
+            volumes=spec.get("volumes", []) or [],
+            **kw,
         ))
 
 
