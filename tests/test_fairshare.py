@@ -166,6 +166,44 @@ class TestDRF:
         close_session(ssn)
 
 
+class TestDRFInKernel:
+    @pytest.mark.parametrize("mode", ["solver", "host"])
+    def test_saturated_cluster_splits_between_equal_jobs(self, mode):
+        """Two equal jobs (min 1) competing for 8 cpus: live DRF ordering
+        must split the cluster ~4:4 instead of the static snapshot order
+        handing everything to the first job. In solver mode the shares are
+        recomputed on device every admission round (SURVEY §7 stage 4);
+        host mode re-sorts via the drf event handlers."""
+        from volcano_tpu.conf import Configuration
+        from volcano_tpu.framework import get_action
+
+        store, cache = make_cluster(
+            [build_node(f"n{i}", {"cpu": "2", "memory": "8Gi"})
+             for i in range(4)],
+            [build_pod_group("pg1", min_member=1),
+             build_pod_group("pg2", min_member=1)],
+            [build_pod("default", f"a{i}", "", "Pending",
+                       {"cpu": "1", "memory": "1Gi"}, "pg1")
+             for i in range(8)]
+            + [build_pod("default", f"b{i}", "", "Pending",
+                         {"cpu": "1", "memory": "1Gi"}, "pg2")
+               for i in range(8)])
+        tiers = [Tier(plugins=[PluginOption(name="drf"),
+                               PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers,
+                           [Configuration("allocate", {"mode": mode})])
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        placed_1 = sum(1 for k in cache.binder.binds if k.startswith(
+            "default/a"))
+        placed_2 = sum(1 for k in cache.binder.binds if k.startswith(
+            "default/b"))
+        assert placed_1 + placed_2 == 8
+        assert placed_1 == 4 and placed_2 == 4, (placed_1, placed_2)
+
+
 class TestHDRF:
     def test_rescaling(self):
         """hdrf_test.go 'rescaling test': 10-cpu/10G node; sci gets half,

@@ -161,6 +161,19 @@ class AllocateAction(Action):
         if use_queue_cap:
             self._fill_queue_arrays(arr, queue_opts, ssn)
 
+        # live DRF ordering on device (drf plugin active): the kernel
+        # re-ranks jobs by dominant share every round
+        drf_opts = ssn.solver_options.get("drf_order")
+        use_drf_order = bool(drf_opts) and not sequential
+        if use_drf_order:
+            attrs = drf_opts["job_attrs"]
+            for j, job in enumerate(arr.jobs_list):
+                attr = attrs.get(job.uid)
+                if attr is not None:
+                    arr.job_drf_allocated[j] = \
+                        attr.allocated.to_vector(arr.vocab)
+            arr.drf_total = drf_opts["total"].to_vector(arr.vocab)
+
         params, families = build_score_inputs(ssn, arr)
         herd = ssn.solver_options.get("herd_mode")
         if herd is None:
@@ -180,7 +193,8 @@ class AllocateAction(Action):
             fbuf, ibuf, layout = arr.packed()
             assigned, kind, _info = sidecar.solve(
                 fbuf, ibuf, layout, params, herd_mode=herd,
-                score_families=families, use_queue_cap=use_queue_cap)
+                score_families=families, use_queue_cap=use_queue_cap,
+                use_drf_order=use_drf_order)
             res = None
         elif dc is not None:
             # device-resident buffers: per-session upload = dirty chunks only
@@ -189,11 +203,13 @@ class AllocateAction(Action):
             f2d, i2d = dc.update(fbuf, ibuf, layout)
             res = solve_allocate_packed2d(
                 f2d, i2d, layout, params, herd_mode=herd,
-                score_families=families, use_queue_cap=use_queue_cap)
+                score_families=families, use_queue_cap=use_queue_cap,
+                use_drf_order=use_drf_order)
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
-                score_families=families, use_queue_cap=use_queue_cap)
+                score_families=families, use_queue_cap=use_queue_cap,
+                use_drf_order=use_drf_order)
         if res is not None:
             # one int16 readback instead of two int32 ones: the tunnel to a
             # remote chip is bandwidth-poor, so the result wire format

@@ -171,6 +171,10 @@ class SnapshotArrays:
     job_ready_base: np.ndarray = None   # [J] ready_task_num at snapshot
     job_queue: np.ndarray = None        # [J] -> queue index
     job_valid: np.ndarray = None        # [J] bool
+    # DRF ordering inputs (filled by the allocate action from the drf
+    # plugin's session-open attrs; zeros when drf is inactive)
+    job_drf_allocated: np.ndarray = None  # [J,R]
+    drf_total: np.ndarray = None          # [R]
     # -- nodes ---------------------------------------------------------------
     nodes_list: List[NodeInfo] = field(default_factory=list)
     node_idle: np.ndarray = None        # [N,R]
@@ -259,6 +263,8 @@ class SnapshotArrays:
             "job_ready_base": self.job_ready_base,
             "job_queue": self.job_queue,
             "job_valid": self.job_valid,
+            "job_drf_allocated": self.job_drf_allocated,
+            "drf_total": self.drf_total,
             "node_idle": self.node_idle,
             "node_extra_future": self.node_extra_future,
             "node_used": self.node_used,
@@ -677,6 +683,12 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
                 cap_vec = Resource.from_resource_list(cap).to_vector(vocab)
                 arr.queue_capability[q_idx] = np.where(
                     cap_vec > 0, cap_vec, np.inf)
+
+    # DRF ordering inputs default to zeros (drf inactive -> static rank);
+    # the allocate action overwrites them from the drf plugin's attrs
+    arr.job_drf_allocated = np.zeros((arr.job_min.shape[0], R),
+                                     dtype=np.float32)
+    arr.drf_total = np.zeros(R, dtype=np.float32)
 
     arr.thresholds = vocab.thresholds()
     arr.scalar_dim_mask = np.zeros(R, dtype=bool)

@@ -361,7 +361,8 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
 @functools.partial(jax.jit, static_argnames=("max_rounds", "max_gang_iters",
                                              "per_node_cap", "herd_mode",
                                              "score_families",
-                                             "use_queue_cap"))
+                                             "use_queue_cap",
+                                             "use_drf_order"))
 def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    score_params: Dict[str, jnp.ndarray],
                    max_rounds: int = 64,
@@ -369,13 +370,22 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    per_node_cap: int = 0,
                    herd_mode: str = "pack",
                    score_families: Tuple[str, ...] = ("binpack", "kube"),
-                   use_queue_cap: bool = False) -> SolveResult:
+                   use_queue_cap: bool = False,
+                   use_drf_order: bool = False) -> SolveResult:
     """Round-based allocate+pipeline solve with in-kernel gang semantics.
 
     With ``use_queue_cap`` (proportion plugin active) per-queue deserved is
     water-filled on device from queue_weight/capability/request and each
     round's admissions are capped at deserved per queue, so a 3:1 weight
     split of a saturated cluster yields a 3:1 allocation split.
+
+    With ``use_drf_order`` (drf plugin active) the admission priority is
+    recomputed every round from live dominant shares (SURVEY §7 stage 4:
+    DRF shares as on-device reductions for ordering): each job's share is
+    max_r(allocated_r / total_r) including this solve's placements, jobs
+    sort ascending by share, and tasks inherit their job's position — so a
+    saturated cluster splits between equal competitors instead of the
+    static snapshot order handing everything to the first job.
     """
     a = arrays
     T = a["task_init_req"].shape[0]
@@ -400,24 +410,88 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         q_perm = q_seg_start = None
         qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
+    if use_drf_order:
+        first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(rank)
+        within_rank = rank - first_rank[a["task_job"]]
+        drf_total = jnp.maximum(a["drf_total"], 1e-9)
+        jobres0 = a["job_drf_allocated"]
+        # per-task dominant-share increment and static job segmentation
+        # (tasks are grouped contiguously by job in rank order)
+        incr_t = jnp.max(
+            jnp.where(a["drf_total"][None, :] > 0.0,
+                      a["task_req"] / drf_total[None, :], 0.0), axis=1)
+        j_seg_start = jnp.concatenate(
+            [jnp.array([True]), a["task_job"][1:] != a["task_job"][:-1]])
+    else:
+        jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
+
+    def drf_share(jobres):
+        share = jnp.max(
+            jnp.where(a["drf_total"][None, :] > 0.0,
+                      jobres / drf_total[None, :], 0.0), axis=1)     # [J]
+        return jnp.where(a["job_valid"], share, jnp.inf)
+
+    def drf_rank(jobres):
+        """Dense per-task priority from live dominant shares: lower-share
+        jobs first, original order within a job and among ties."""
+        job_pos = jnp.zeros(J, jnp.int32).at[
+            jnp.argsort(drf_share(jobres), stable=True)].set(
+            jnp.arange(J, dtype=jnp.int32))
+        order_t = jnp.lexsort((within_rank, job_pos[a["task_job"]]))
+        return jnp.zeros(T, jnp.int32).at[order_t].set(
+            jnp.arange(T, dtype=jnp.int32))
+
+    def drf_cap(eligible, jobres):
+        """Progressive-filling headroom: per round a job may only grow its
+        dominant share to (the minimum competing share) + one step, so a
+        saturated cluster converges to equal shares instead of the first
+        job swallowing a whole round. The step is at least one task and at
+        least 1/(8 x competing jobs), bounding convergence at ~8 rounds of
+        the remaining gap (drf.go's job-order re-sort after every single
+        placement, in round-sized bites)."""
+        share = drf_share(jobres)
+        elig_job = jnp.zeros(J, jnp.int32).at[a["task_job"]].max(
+            eligible.astype(jnp.int32)) > 0
+        n_elig = jnp.maximum(jnp.sum(elig_job), 1)
+        m = jnp.min(jnp.where(elig_job, share, jnp.inf))
+        max_incr = jnp.max(jnp.where(eligible, incr_t, 0.0))
+        step = jnp.maximum(max_incr, 1.0 / (8.0 * n_elig))
+        allowed = jnp.maximum(share, m) + step                   # [J]
+        cum = _segment_prefix((incr_t * eligible)[:, None],
+                              j_seg_start)[:, 0] + incr_t
+        # absolute comparison (share + cum vs allowed): subtracting share
+        # from allowed first loses a float32 ulp and starves exact steps
+        return eligible & (share[a["task_job"]] + cum
+                           <= allowed[a["task_job"]] + 1e-6)
+
     def phase_rounds(st, use_future: bool):
         """Run admission rounds to fixpoint against idle (allocate) or
-        future-idle (pipeline). st: 8-tuple carry."""
+        future-idle (pipeline). st: 9-tuple carry (idle, pipe, npods,
+        qalloc, jobres, assigned, kind, excluded, rounds)."""
 
         def cond(s):
             changed, rounds = s[-1], s[-2]
             return changed & (rounds < max_rounds)
 
         def body(s):
-            idle, pipe, npods, qalloc, assigned, kind, excluded, rounds, _ = s
+            (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+             rounds, _) = s
             avail = (idle + a["node_extra_future"] - pipe) if use_future else idle
             eligible = (a["task_valid"] & (assigned < 0)
                         & ~excluded[a["task_job"]])
+            # per-round admission priority: live DRF shares when active
+            if use_drf_order:
+                r_rank = drf_rank(jobres)
+                eligible = drf_cap(eligible, jobres)
+            else:
+                r_rank = rank
             if use_queue_cap:
                 qrem = jnp.maximum(deserved - qalloc, 0.0)
+                qp = (jnp.lexsort((r_rank, task_queue)) if use_drf_order
+                      else q_perm)
                 eligible = eligible & _queue_cap_mask(
                     eligible, task_queue, a["task_req"], qrem, thr,
-                    scalar_mask, q_perm, q_seg_start)
+                    scalar_mask, qp, q_seg_start)
             feas = fits_matrix(a["task_init_req"], avail, thr, scalar_mask) & sig_feas
             used_now = a["node_used"] + (a["node_idle"] - idle)
             score = score_matrix(a["task_init_req"], avail, used_now,
@@ -425,7 +499,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                                  score_families)
             new_assign, debit, pod_inc = _admission_round(
                 eligible, feas, score, a["task_init_req"], a["task_req"],
-                avail, rank, thr, scalar_mask, npods, a["node_max_pods"],
+                avail, r_rank, thr, scalar_mask, npods, a["node_max_pods"],
                 per_node_cap, herd_mode)
             got = new_assign >= 0
             assigned = jnp.where(got, new_assign, assigned)
@@ -436,30 +510,36 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 qalloc = qalloc + jax.ops.segment_sum(
                     a["task_req"] * got[:, None], task_queue,
                     num_segments=Q)
+            if use_drf_order:
+                jobres = jobres + jax.ops.segment_sum(
+                    a["task_req"] * got[:, None], a["task_job"],
+                    num_segments=J)
             if use_future:
                 pipe = pipe + debit
             else:
                 idle = idle - debit
                 npods = npods + pod_inc
-            return (idle, pipe, npods, qalloc, assigned, kind, excluded,
-                    rounds + 1, jnp.any(got))
+            return (idle, pipe, npods, qalloc, jobres, assigned, kind,
+                    excluded, rounds + 1, jnp.any(got))
 
         # skip the phase outright when no task is still eligible (e.g. the
         # pipeline phase after everything allocated): one [T] reduction
         # instead of a full wasted [T,N] round
-        _, _, _, _, assigned0, _, excluded0, _ = st
+        _, _, _, _, _, assigned0, _, excluded0, _ = st
         any_eligible = jnp.any(a["task_valid"] & (assigned0 < 0)
                                & ~excluded0[a["task_job"]])
         out = jax.lax.while_loop(cond, body, st + (any_eligible,))
         return out[:-1]
 
     def gang_body(s):
-        (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
-         _, it, reverted_once) = s
-        st = (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds)
+        (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+         rounds, _, it, reverted_once) = s
+        st = (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+              rounds)
         st = phase_rounds(st, use_future=False)
         st = phase_rounds(st, use_future=True)
-        idle, pipe, npods, qalloc, assigned, kind, excluded, rounds = st
+        (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+         rounds) = st
 
         # gang check: allocated (kind 0, counts_ready) per job
         alloc_counts = jax.ops.segment_sum(
@@ -491,6 +571,10 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             qalloc = qalloc - jax.ops.segment_sum(
                 a["task_req"] * revert_task[:, None], task_queue,
                 num_segments=Q)
+        if use_drf_order:
+            jobres = jobres - jax.ops.segment_sum(
+                a["task_req"] * revert_task[:, None], a["task_job"],
+                num_segments=J)
         assigned = jnp.where(revert_task, -1, assigned)
         kind = jnp.where(revert_task, -1, kind)
         # one retry per job: a first revert leaves the job eligible for the
@@ -500,11 +584,11 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         excluded = excluded | (revert_job & reverted_once)
         reverted_once = reverted_once | revert_job
         any_revert = jnp.any(revert_job)
-        return (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
-                any_revert, it + 1, reverted_once)
+        return (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+                rounds, any_revert, it + 1, reverted_once)
 
     init = (a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"],
-            qalloc0,
+            qalloc0, jobres0,
             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
             ~a["job_valid"], jnp.int32(0), jnp.bool_(True), jnp.int32(0),
             jnp.zeros(J, dtype=bool))
@@ -513,7 +597,8 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     s = jax.lax.while_loop(
         lambda s: s[-3] & (s[-2] < max_gang_iters), gang_body, init)
 
-    idle, pipe, npods, _, assigned, kind, excluded, rounds, _, _, _ = s
+    (idle, pipe, npods, _, _, assigned, kind, excluded, rounds,
+     _, _, _) = s
     alloc_counts = jax.ops.segment_sum(
         ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
         a["task_job"], num_segments=J)
@@ -686,7 +771,7 @@ def _unpack(fbuf, ibuf, layout):
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
-    "score_families", "use_queue_cap"))
+    "score_families", "use_queue_cap", "use_drf_order"))
 def solve_allocate_packed2d(f2d, i2d, layout,
                             score_params: Dict[str, jnp.ndarray],
                             max_rounds: int = 64,
@@ -694,7 +779,8 @@ def solve_allocate_packed2d(f2d, i2d, layout,
                             per_node_cap: int = 0,
                             herd_mode: str = "pack",
                             score_families: Tuple[str, ...] = ("binpack",),
-                            use_queue_cap: bool = False) -> SolveResult:
+                            use_queue_cap: bool = False,
+                            use_drf_order: bool = False) -> SolveResult:
     """solve_allocate over the chunked device-resident buffers kept by
     ops.device_cache.PackedDeviceCache: per-session upload is only the
     dirty chunks; the flatten+slice here fuses away on device."""
@@ -707,12 +793,12 @@ def solve_allocate_packed2d(f2d, i2d, layout,
     arrays = _unpack(fbuf, ibuf, layout)
     return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
                           per_node_cap, herd_mode, score_families,
-                          use_queue_cap)
+                          use_queue_cap, use_drf_order)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
-    "score_families", "use_queue_cap"))
+    "score_families", "use_queue_cap", "use_drf_order"))
 def solve_allocate_packed(fbuf, ibuf, layout,
                           score_params: Dict[str, jnp.ndarray],
                           max_rounds: int = 64,
@@ -720,10 +806,11 @@ def solve_allocate_packed(fbuf, ibuf, layout,
                           per_node_cap: int = 0,
                           herd_mode: str = "pack",
                           score_families: Tuple[str, ...] = ("binpack",),
-                          use_queue_cap: bool = False) -> SolveResult:
+                          use_queue_cap: bool = False,
+                          use_drf_order: bool = False) -> SolveResult:
     """solve_allocate over buffers produced by SnapshotArrays.packed():
     the unpack is free on device (slices fuse), the transfer is 2 puts."""
     arrays = _unpack(fbuf, ibuf, layout)
     return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
                           per_node_cap, herd_mode, score_families,
-                          use_queue_cap)
+                          use_queue_cap, use_drf_order)

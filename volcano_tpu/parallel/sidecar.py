@@ -112,7 +112,8 @@ class SolverServer:
             f2d, i2d, layout, params,
             herd_mode=header["herd_mode"],
             score_families=tuple(header["score_families"]),
-            use_queue_cap=header["use_queue_cap"])
+            use_queue_cap=header["use_queue_cap"],
+            use_drf_order=header.get("use_drf_order", False))
         return {"rounds": int(np.asarray(res.rounds)),
                 "shipped_chunks": dcache.last_shipped_chunks}, \
             [np.asarray(res.assigned), np.asarray(res.kind)]
@@ -233,7 +234,8 @@ class SidecarSolver:
     def solve(self, fbuf, ibuf, layout, params,
               herd_mode: str = "pack",
               score_families: Tuple[str, ...] = ("binpack",),
-              use_queue_cap: bool = False):
+              use_queue_cap: bool = False,
+              use_drf_order: bool = False):
         """Returns (assigned [T] int32, kind [T] int32, info dict)."""
         names, blobs = [], [fbuf, ibuf]
         for name, val in params.items():
@@ -246,6 +248,7 @@ class SidecarSolver:
             "herd_mode": herd_mode,
             "score_families": list(score_families),
             "use_queue_cap": bool(use_queue_cap),
+            "use_drf_order": bool(use_drf_order),
         }
         out_header, out_blobs = self._request(header, blobs)
         return out_blobs[0], out_blobs[1], out_header

@@ -223,6 +223,21 @@ class DRFPlugin(Plugin):
         for n in ssn.nodes.values():
             self.total_resource.add(n.allocatable)
 
+        # feed the solver: per-round dominant-share job ordering runs as
+        # on-device reductions (SURVEY §7 stage 4); allocate fills the
+        # flatten's job_drf_allocated/drf_total arrays from these attrs.
+        # Honors the tier's enabledJobOrder gate like the host dispatch
+        # (session.py _tier_fns), so a config that disabled DRF ordering
+        # doesn't get it back on the solver path.
+        from ..framework.session import _enabled
+        if any(opt.name == self.name()
+               and _enabled(opt, "enabled_job_order")
+               for tier in ssn.tiers for opt in tier.plugins):
+            ssn.solver_options["drf_order"] = {
+                "job_attrs": self.job_attrs,
+                "total": self.total_resource,
+            }
+
         namespace_order = self._namespace_order_enabled(ssn)
         hierarchy = self._hierarchy_enabled(ssn)
 
