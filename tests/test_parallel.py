@@ -107,3 +107,37 @@ class TestShardedSolver:
             placed_q1 = int((a[:8] >= 0).sum())
             placed_q2 = int((a[8:16] >= 0).sum())
             assert (placed_q1, placed_q2) == (6, 2), (placed_q1, placed_q2)
+
+    def test_drf_order_matches_single_chip(self, mesh):
+        """Live DRF ordering on the mesh: two equal jobs split a saturated
+        8-cpu cluster 4:4, matching the single-device kernel."""
+        nodes = {f"n{i}": NodeInfo(build_node(
+            f"n{i}", {"cpu": "1", "memory": "100Gi"})) for i in range(8)}
+        jobs, tasks = {}, []
+        for jname in ("jA", "jB"):
+            pg = build_pod_group(jname, "ns", min_member=1)
+            job = JobInfo(f"ns/{jname}", pg)
+            for i in range(8):
+                p = build_pod("ns", f"{jname}-{i}", "", "Pending",
+                              {"cpu": "1", "memory": "1Gi"}, jname)
+                t = TaskInfo(p)
+                job.add_task_info(t)
+                tasks.append(t)
+            jobs[job.uid] = job
+        arr = flatten_snapshot(jobs, nodes, tasks)
+        # drf inputs: nothing allocated yet, total = cluster capacity
+        arr.drf_total[:] = 0.0
+        arr.drf_total[0] = 8000.0
+        arr.drf_total[1] = 800 * (1 << 30)
+        p = params_dict(arr, least_req_weight=1.0)
+        single = solve_allocate(arr.device_dict(), p, herd_mode="spread",
+                                score_families=("kube",),
+                                use_drf_order=True)
+        sharded = solve_allocate_sharded(arr.device_dict(), p, mesh,
+                                         herd_mode="spread",
+                                         score_families=("kube",),
+                                         use_drf_order=True)
+        for res in (single, sharded):
+            a = np.asarray(res.assigned)
+            placed = (int((a[:8] >= 0).sum()), int((a[8:16] >= 0).sum()))
+            assert placed == (4, 4), placed

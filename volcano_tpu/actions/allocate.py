@@ -162,9 +162,22 @@ class AllocateAction(Action):
             self._fill_queue_arrays(arr, queue_opts, ssn)
 
         # live DRF ordering on device (drf plugin active): the kernel
-        # re-ranks jobs by dominant share every round
+        # re-ranks jobs by dominant share every round. Only when drf is
+        # the effective job-order authority: any OTHER job-order plugin
+        # dispatched before it (e.g. a higher-tier priority plugin, whose
+        # strict precedence the share re-rank would override) keeps the
+        # static composite order. gang's unready-first ordering above drf
+        # is tolerated — the flatten holds pending-task jobs, for which
+        # progressive filling and unready-first are compatible.
         drf_opts = ssn.solver_options.get("drf_order")
         use_drf_order = bool(drf_opts) and not sequential
+        if use_drf_order:
+            providers = [name for _, name, _
+                         in ssn._tier_fns("job_order_fns")]
+            if "drf" not in providers or any(
+                    p not in ("gang", "drf")
+                    for p in providers[:providers.index("drf")]):
+                use_drf_order = False
         if use_drf_order:
             attrs = drf_opts["job_attrs"]
             for j, job in enumerate(arr.jobs_list):

@@ -190,6 +190,63 @@ def water_fill_deserved(total, weight, cap, request, thr, max_iters: int):
     return deserved
 
 
+def drf_state(a, rank):
+    """Shared prelude for in-kernel DRF ordering (single-device and
+    mesh-sharded solvers): returns (jobres0, drf_rank, drf_cap). All the
+    math is replicated-safe — shares are [J] reductions, ranks [T] sorts.
+
+    drf_rank(jobres): dense per-task priority from live dominant shares
+    (lower-share jobs first, original order within a job and among ties).
+    drf_cap(eligible, jobres): progressive-filling headroom — per round a
+    job may only grow its dominant share to (the minimum competing share)
+    + one step, at least one task and at least 1/(8 x competing jobs), so
+    a saturated cluster converges to equal shares in a handful of rounds
+    (drf.go's per-placement job re-sort, in round-sized bites)."""
+    T = a["task_rank"].shape[0]
+    J = a["job_min"].shape[0]
+    rank = a["task_rank"] if rank is None else rank
+    first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(rank)
+    within_rank = rank - first_rank[a["task_job"]]
+    drf_total = jnp.maximum(a["drf_total"], 1e-9)
+    incr_t = jnp.max(
+        jnp.where(a["drf_total"][None, :] > 0.0,
+                  a["task_req"] / drf_total[None, :], 0.0), axis=1)
+    j_seg_start = jnp.concatenate(
+        [jnp.array([True]), a["task_job"][1:] != a["task_job"][:-1]])
+
+    def drf_share(jobres):
+        share = jnp.max(
+            jnp.where(a["drf_total"][None, :] > 0.0,
+                      jobres / drf_total[None, :], 0.0), axis=1)     # [J]
+        return jnp.where(a["job_valid"], share, jnp.inf)
+
+    def drf_rank(jobres):
+        job_pos = jnp.zeros(J, jnp.int32).at[
+            jnp.argsort(drf_share(jobres), stable=True)].set(
+            jnp.arange(J, dtype=jnp.int32))
+        order_t = jnp.lexsort((within_rank, job_pos[a["task_job"]]))
+        return jnp.zeros(T, jnp.int32).at[order_t].set(
+            jnp.arange(T, dtype=jnp.int32))
+
+    def drf_cap(eligible, jobres):
+        share = drf_share(jobres)
+        elig_job = jnp.zeros(J, jnp.int32).at[a["task_job"]].max(
+            eligible.astype(jnp.int32)) > 0
+        n_elig = jnp.maximum(jnp.sum(elig_job), 1)
+        m = jnp.min(jnp.where(elig_job, share, jnp.inf))
+        max_incr = jnp.max(jnp.where(eligible, incr_t, 0.0))
+        step = jnp.maximum(max_incr, 1.0 / (8.0 * n_elig))
+        allowed = jnp.maximum(share, m) + step                   # [J]
+        cum = _segment_prefix((incr_t * eligible)[:, None],
+                              j_seg_start)[:, 0] + incr_t
+        # absolute comparison (share + cum vs allowed): subtracting share
+        # from allowed first loses a float32 ulp and starves exact steps
+        return eligible & (share[a["task_job"]] + cum
+                           <= allowed[a["task_job"]] + 1e-6)
+
+    return a["job_drf_allocated"], drf_rank, drf_cap
+
+
 def queue_cap_state(a, rank, thr, total):
     """Shared prelude for in-kernel queue fair share (used by the
     single-device and mesh-sharded solvers — only the cluster `total`
@@ -411,58 +468,10 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
     if use_drf_order:
-        first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(rank)
-        within_rank = rank - first_rank[a["task_job"]]
-        drf_total = jnp.maximum(a["drf_total"], 1e-9)
-        jobres0 = a["job_drf_allocated"]
-        # per-task dominant-share increment and static job segmentation
-        # (tasks are grouped contiguously by job in rank order)
-        incr_t = jnp.max(
-            jnp.where(a["drf_total"][None, :] > 0.0,
-                      a["task_req"] / drf_total[None, :], 0.0), axis=1)
-        j_seg_start = jnp.concatenate(
-            [jnp.array([True]), a["task_job"][1:] != a["task_job"][:-1]])
+        jobres0, drf_rank, drf_cap = drf_state(a, rank)
     else:
         jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
-
-    def drf_share(jobres):
-        share = jnp.max(
-            jnp.where(a["drf_total"][None, :] > 0.0,
-                      jobres / drf_total[None, :], 0.0), axis=1)     # [J]
-        return jnp.where(a["job_valid"], share, jnp.inf)
-
-    def drf_rank(jobres):
-        """Dense per-task priority from live dominant shares: lower-share
-        jobs first, original order within a job and among ties."""
-        job_pos = jnp.zeros(J, jnp.int32).at[
-            jnp.argsort(drf_share(jobres), stable=True)].set(
-            jnp.arange(J, dtype=jnp.int32))
-        order_t = jnp.lexsort((within_rank, job_pos[a["task_job"]]))
-        return jnp.zeros(T, jnp.int32).at[order_t].set(
-            jnp.arange(T, dtype=jnp.int32))
-
-    def drf_cap(eligible, jobres):
-        """Progressive-filling headroom: per round a job may only grow its
-        dominant share to (the minimum competing share) + one step, so a
-        saturated cluster converges to equal shares instead of the first
-        job swallowing a whole round. The step is at least one task and at
-        least 1/(8 x competing jobs), bounding convergence at ~8 rounds of
-        the remaining gap (drf.go's job-order re-sort after every single
-        placement, in round-sized bites)."""
-        share = drf_share(jobres)
-        elig_job = jnp.zeros(J, jnp.int32).at[a["task_job"]].max(
-            eligible.astype(jnp.int32)) > 0
-        n_elig = jnp.maximum(jnp.sum(elig_job), 1)
-        m = jnp.min(jnp.where(elig_job, share, jnp.inf))
-        max_incr = jnp.max(jnp.where(eligible, incr_t, 0.0))
-        step = jnp.maximum(max_incr, 1.0 / (8.0 * n_elig))
-        allowed = jnp.maximum(share, m) + step                   # [J]
-        cum = _segment_prefix((incr_t * eligible)[:, None],
-                              j_seg_start)[:, 0] + incr_t
-        # absolute comparison (share + cum vs allowed): subtracting share
-        # from allowed first loses a float32 ulp and starves exact steps
-        return eligible & (share[a["task_job"]] + cum
-                           <= allowed[a["task_job"]] + 1e-6)
+        drf_rank = drf_cap = None
 
     def phase_rounds(st, use_future: bool):
         """Run admission rounds to fixpoint against idle (allocate) or

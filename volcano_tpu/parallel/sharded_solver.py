@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.solver import (
     NEG, BIG_KEY, SolveResult, _queue_cap_mask, _segment_prefix,
-    fits_matrix, le_fits, queue_cap_state, score_matrix,
+    drf_state, fits_matrix, le_fits, queue_cap_state, score_matrix,
 )
 
 
@@ -53,7 +53,8 @@ def make_mesh(devices=None, axis: str = "n") -> Mesh:
 @functools.partial(jax.jit, static_argnames=("mesh", "max_rounds",
                                              "max_gang_iters", "herd_mode",
                                              "score_families",
-                                             "use_queue_cap"))
+                                             "use_queue_cap",
+                                             "use_drf_order"))
 def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            score_params: Dict[str, jnp.ndarray],
                            mesh: Mesh,
@@ -61,7 +62,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            max_gang_iters: int = 8,
                            herd_mode: str = "pack",
                            score_families: Tuple[str, ...] = ("binpack",),
-                           use_queue_cap: bool = False) -> SolveResult:
+                           use_queue_cap: bool = False,
+                           use_drf_order: bool = False) -> SolveResult:
     a = arrays
     T = a["task_init_req"].shape[0]
     N = a["node_idle"].shape[0]
@@ -89,6 +91,10 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         # cross-device input is the cluster-total capacity, one psum)
         in_specs.update({"queue_weight": P(), "queue_capability": P(),
                          "queue_allocated": P(), "queue_request": P()})
+    if use_drf_order:
+        # live DRF ordering: shares are [J] reductions over replicated
+        # job state, identical on every device
+        in_specs.update({"job_drf_allocated": P(), "drf_total": P()})
     params_spec = {k: (P("n") if k == "node_static" else P())
                    for k in score_params}
 
@@ -108,6 +114,11 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
             qalloc0 = a["queue_allocated"]
         else:
             qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
+
+        if use_drf_order:
+            jobres0, drf_rank, drf_cap = drf_state(a, rank)
+        else:
+            jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
         def choose(eligible, avail, idle, npods):
             """Global choice per task: local scoring + cross-device argmax,
@@ -175,12 +186,12 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 choice = personal
             return choice, feas
 
-        def admit_local(choice, feas, avail, npods):
+        def admit_local(choice, feas, avail, npods, r_rank):
             """Admission for choices landing in this device's shard."""
             c_loc = choice - my_base
             mine = (c_loc >= 0) & (c_loc < n_loc) & (choice >= 0)
             c_loc = jnp.where(mine, c_loc, -1)
-            key = jnp.where(mine, c_loc * (T + 1) + rank, BIG_KEY)
+            key = jnp.where(mine, c_loc * (T + 1) + r_rank, BIG_KEY)
             perm = jnp.argsort(key)
             s_choice = c_loc[perm]
             s_active = s_choice >= 0
@@ -214,20 +225,27 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 return s[-1] & (s[-2] < max_rounds)
 
             def body(s):
-                (idle, pipe, npods, qalloc, assigned, kind, excluded,
-                 rounds, _) = s
+                (idle, pipe, npods, qalloc, jobres, assigned, kind,
+                 excluded, rounds, _) = s
                 avail = (idle + a["node_extra_future"] - pipe) if use_future \
                     else idle
                 eligible = (a["task_valid"] & (assigned < 0)
                             & ~excluded[a["task_job"]])
+                if use_drf_order:
+                    r_rank = drf_rank(jobres)
+                    eligible = drf_cap(eligible, jobres)
+                else:
+                    r_rank = rank
                 if use_queue_cap:
                     qrem = jnp.maximum(deserved - qalloc, 0.0)
+                    qp = (jnp.lexsort((r_rank, task_queue))
+                          if use_drf_order else q_perm)
                     eligible = eligible & _queue_cap_mask(
                         eligible, task_queue, a["task_req"], qrem, thr,
-                        scalar_mask, q_perm, q_seg_start)
+                        scalar_mask, qp, q_seg_start)
                 choice, feas = choose(eligible, avail, idle, npods)
                 new_assign, debit, pod_inc = admit_local(
-                    choice, feas, avail, npods)
+                    choice, feas, avail, npods, r_rank)
                 got = new_assign >= 0
                 assigned = jnp.where(got, new_assign, assigned)
                 kind = jnp.where(got, jnp.int32(1 if use_future else 0), kind)
@@ -237,25 +255,30 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     qalloc = qalloc + jax.ops.segment_sum(
                         a["task_req"] * got[:, None], task_queue,
                         num_segments=Q)
+                if use_drf_order:
+                    jobres = jobres + jax.ops.segment_sum(
+                        a["task_req"] * got[:, None], a["task_job"],
+                        num_segments=J)
                 if use_future:
                     pipe = pipe + debit
                 else:
                     idle = idle - debit
                     npods = npods + pod_inc
-                return (idle, pipe, npods, qalloc, assigned, kind, excluded,
-                        rounds + 1, jnp.any(got))
+                return (idle, pipe, npods, qalloc, jobres, assigned, kind,
+                        excluded, rounds + 1, jnp.any(got))
 
             out = jax.lax.while_loop(cond, body, st + (jnp.bool_(True),))
             return out[:-1]
 
         def gang_body(s):
-            (idle, pipe, npods, qalloc, assigned, kind, excluded, rounds,
-             _, it, reverted_once) = s
-            st = (idle, pipe, npods, qalloc, assigned, kind, excluded,
-                  rounds)
+            (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+             rounds, _, it, reverted_once) = s
+            st = (idle, pipe, npods, qalloc, jobres, assigned, kind,
+                  excluded, rounds)
             st = phase_rounds(st, False)
             st = phase_rounds(st, True)
-            idle, pipe, npods, qalloc, assigned, kind, excluded, rounds = st
+            (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+             rounds) = st
             alloc_counts = jax.ops.segment_sum(
                 ((assigned >= 0) & (kind == 0)).astype(jnp.int32)
                 * counts_ready, a["task_job"], num_segments=J)
@@ -282,24 +305,29 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 qalloc = qalloc - jax.ops.segment_sum(
                     a["task_req"] * revert_task[:, None], task_queue,
                     num_segments=Q)
+            if use_drf_order:
+                jobres = jobres - jax.ops.segment_sum(
+                    a["task_req"] * revert_task[:, None], a["task_job"],
+                    num_segments=J)
             assigned = jnp.where(revert_task, -1, assigned)
             kind = jnp.where(revert_task, -1, kind)
             # one retry per job before permanent exclusion, matching the
             # single-device gang fixpoint (ops/solver.py gang_body)
             excluded = excluded | (revert_job & reverted_once)
             reverted_once = reverted_once | revert_job
-            return (idle, pipe, npods, qalloc, assigned, kind, excluded,
-                    rounds, jnp.any(revert_job), it + 1, reverted_once)
+            return (idle, pipe, npods, qalloc, jobres, assigned, kind,
+                    excluded, rounds, jnp.any(revert_job), it + 1,
+                    reverted_once)
 
         init = (a["node_idle"], jnp.zeros_like(a["node_idle"]),
-                a["node_npods"], qalloc0,
+                a["node_npods"], qalloc0, jobres0,
                 jnp.full((T,), -1, jnp.int32),
                 jnp.full((T,), -1, jnp.int32), ~a["job_valid"],
                 jnp.int32(0), jnp.bool_(True), jnp.int32(0),
                 jnp.zeros(J, dtype=bool))
         s = jax.lax.while_loop(
             lambda s: s[-3] & (s[-2] < max_gang_iters), gang_body, init)
-        (idle, pipe, npods, _, assigned, kind, excluded, rounds,
+        (idle, pipe, npods, _, _, assigned, kind, excluded, rounds,
          _, _, _) = s
         alloc_counts = jax.ops.segment_sum(
             ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
