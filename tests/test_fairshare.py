@@ -338,3 +338,48 @@ class TestWaterFillKernel:
             assert d[i][1] == pytest.approx(
                 pp.queue_opts[n].deserved.memory, rel=1e-3), n
         close_session(ssn)
+
+
+class TestHeterogeneousQueueProfiles:
+    @pytest.mark.parametrize("mode", ["solver", "host"])
+    def test_disjoint_resource_queues_fully_utilize(self, mode):
+        """A cpu-heavy queue and a memory-heavy queue on one cluster.
+
+        The reference STRANDS capacity here: a queue goes overused as soon
+        as ANY dim exceeds its jointly-water-filled deserved
+        (proportion.go:245 `!allocated.LessEqual(deserved)`), so each
+        queue stops near half its own resource although nobody else wants
+        it. Host mode reproduces that faithfully. The production rounds
+        kernel improves on it: capped phases enforce the same fair shares
+        first, then work-conserving overflow phases hand out capacity no
+        competing queue could take — both queues fill their resource."""
+        from volcano_tpu.conf import Configuration
+        from volcano_tpu.framework import get_action
+
+        queues = [build_queue("qcpu", weight=1), build_queue("qmem", weight=1)]
+        pgs = [build_pod_group("pgc", queue="qcpu", min_member=1),
+               build_pod_group("pgm", queue="qmem", min_member=1)]
+        # 8 cpu / 8Gi cluster; qcpu wants all cpu (tiny mem), qmem wants
+        # all memory (tiny cpu)
+        pods = ([build_pod("default", f"c{i}", "", "Pending",
+                           {"cpu": "1", "memory": "64Mi"}, "pgc")
+                 for i in range(8)]
+                + [build_pod("default", f"m{i}", "", "Pending",
+                             {"cpu": "100m", "memory": "1Gi"}, "pgm")
+                   for i in range(7)])
+        nodes = [build_node("n1", {"cpu": "9", "memory": "9Gi"})]
+        store, cache = make_cluster(nodes, pgs, pods, queues=queues)
+        tiers = [Tier(plugins=[PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="proportion"),
+                               PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers,
+                           [Configuration("allocate", {"mode": mode})])
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        placed_c = sum(1 for k in cache.binder.binds if "/c" in k)
+        placed_m = sum(1 for k in cache.binder.binds if "/m" in k)
+        if mode == "solver":  # work-conserving: everything places
+            assert (placed_c, placed_m) == (8, 7), (placed_c, placed_m)
+        else:  # faithful reference stranding: stop just past deserved
+            assert placed_c == 5 and placed_m == 5, (placed_c, placed_m)

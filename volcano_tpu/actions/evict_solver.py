@@ -191,7 +191,8 @@ def run_evict_solver(ssn, mode: str):
     tasks_in_order = [t for _, tasks in job_order for t in tasks]
     arr = flatten_snapshot(
         {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
-        queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None),
+        queues=ssn.queues,
+        cache=getattr(ssn, "evict_flatten_cache", None),
         grouped=job_order)
     victims = collect_victims(ssn, arr.nodes_list)
     if not victims:

@@ -262,6 +262,10 @@ class SchedulerCache:
         from ..ops.arrays import FlattenCache
         from ..ops.device_cache import PackedDeviceCache
         self.flatten_cache = FlattenCache()
+        # a separate cache for preempt/reclaim flattens: their task sets
+        # differ per call, and sharing one cache would clobber the allocate
+        # flatten's wholesale fast-path key every cycle
+        self.evict_flatten_cache = FlattenCache()
         # device-resident packed solver buffers (delta-shipped per session)
         self.device_cache = PackedDeviceCache()
         # optional solver-sidecar client (parallel.sidecar.SidecarSolver):
@@ -482,12 +486,35 @@ class SchedulerCache:
 
     # -- snapshot (cache.go:670-748) ----------------------------------------
 
+    #: kubelet-of-last-resort grace: an evicted pod still carrying its
+    #: deletion_timestamp after this long is finalized by the scheduler
+    #: cache itself — scheduler-only embeddings (no ControllerManager, so
+    #: no KubeletStandin) must still converge after evictions
+    EVICTION_FINALIZE_GRACE = 60.0
+
+    def _finalize_expired_evictions(self) -> None:
+        now = time.time()
+        for job in self.jobs.values():
+            for task in list(job.task_status_index.get(
+                    TaskStatus.RELEASING, {}).values()):
+                pod = self.cluster.try_get("pods", task.name,
+                                           task.namespace)
+                if pod is None or pod.deletion_timestamp is None:
+                    continue
+                if now - pod.deletion_timestamp \
+                        > self.EVICTION_FINALIZE_GRACE:
+                    try:
+                        self.cluster.delete("pods", pod.name, pod.namespace)
+                    except NotFoundError:
+                        pass
+
     def snapshot(self) -> ClusterInfo:
         # Take the store's write lock for the whole clone: async effector
         # threads mutate this cache via store listeners (which run under
         # that lock), so holding it here is the SchedulerCache.Mutex of the
         # reference (cache.go:72, Snapshot locks before cloning).
         with self.cluster.locked():
+            self._finalize_expired_evictions()
             return self._snapshot_locked()
 
     def _snapshot_locked(self) -> ClusterInfo:

@@ -91,6 +91,8 @@ class Session:
         self.score_params = ScoreParams()
         self.solver_options: Dict[str, object] = {}
         self.flatten_cache = getattr(cache, "flatten_cache", None)
+        self.evict_flatten_cache = getattr(cache, "evict_flatten_cache",
+                                           None)
         self.device_cache = getattr(cache, "device_cache", None)
         self.sidecar = getattr(cache, "sidecar", None)
 

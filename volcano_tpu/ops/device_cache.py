@@ -19,6 +19,9 @@ import numpy as np
 
 
 def _pow2_bucket(n: int) -> int:
+    """Strict powers of two, deliberately NOT ops.arrays.bucket (whose
+    quarter-steps minimize padding): dirty-chunk counts vary every session,
+    so the scatter kernel wants the fewest possible compiled variants."""
     b = 1
     while b < n:
         b <<= 1

@@ -256,6 +256,14 @@ def queue_cap_state(a, rank, thr, total):
     deserved = water_fill_deserved(
         total, a["queue_weight"], a["queue_capability"],
         a["queue_request"], thr, max_iters=q + 1)
+    # dims a queue never requested must not bind its cap: the reference's
+    # overused check (proportion.go overusedFn: deserved.LessEqual(
+    # allocated)) can never trip on a dim the queue's workloads don't use,
+    # so e.g. a cpu-only queue is not throttled at its (meaningless)
+    # memory deserved. Water-filled deserved on such dims is replaced by
+    # +inf for the per-round caps.
+    deserved = jnp.where(a["queue_request"] > thr[None, :],
+                         deserved, jnp.inf)
     task_queue = a["job_queue"][a["task_job"]]
     t = task_queue.shape[0]
     q_perm = jnp.argsort(task_queue * (t + 1) + rank)
@@ -473,10 +481,15 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
         drf_rank = drf_cap = None
 
-    def phase_rounds(st, use_future: bool):
+    def phase_rounds(st, use_future: bool, capped: bool = True):
         """Run admission rounds to fixpoint against idle (allocate) or
         future-idle (pipeline). st: 9-tuple carry (idle, pipe, npods,
-        qalloc, jobres, assigned, kind, excluded, rounds)."""
+        qalloc, jobres, assigned, kind, excluded, rounds). capped=False is
+        the work-conserving overflow pass: queue fair-share caps are
+        relaxed so capacity no competing queue wants is not stranded (the
+        reference's overused check binds only when a queue saturates its
+        deserved on EVERY dim, so it under-enforces rather than strand —
+        proportion.go overusedFn)."""
 
         def cond(s):
             changed, rounds = s[-1], s[-2]
@@ -494,7 +507,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 eligible = drf_cap(eligible, jobres)
             else:
                 r_rank = rank
-            if use_queue_cap:
+            if use_queue_cap and capped:
                 qrem = jnp.maximum(deserved - qalloc, 0.0)
                 qp = (jnp.lexsort((r_rank, task_queue)) if use_drf_order
                       else q_perm)
@@ -547,6 +560,11 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
               rounds)
         st = phase_rounds(st, use_future=False)
         st = phase_rounds(st, use_future=True)
+        if use_queue_cap:
+            # work-conserving overflow: leftovers no competing queue could
+            # take under its cap go to whoever still wants them
+            st = phase_rounds(st, use_future=False, capped=False)
+            st = phase_rounds(st, use_future=True, capped=False)
         (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
          rounds) = st
 
@@ -642,13 +660,11 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
     sig_feas_all = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
 
     if use_queue_cap:
-        Q = a["queue_weight"].shape[0]
         total = jnp.sum(
             a["node_alloc"] * a["node_valid"][:, None].astype(jnp.float32),
             axis=0)
-        deserved = water_fill_deserved(
-            total, a["queue_weight"], a["queue_capability"],
-            a["queue_request"], thr, max_iters=Q + 1)
+        Q, deserved, _, _, _ = queue_cap_state(a, a["task_rank"], thr,
+                                               total)
         qalloc0 = a["queue_allocated"]
     else:
         deserved = None
@@ -705,6 +721,10 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
         sig_feas = sig_feas_all[i]
         pods_ok = npods < a["node_max_pods"]
         if use_queue_cap:
+            # NOTE: strict per-dim caps, no work-conserving overflow pass
+            # (unlike solve_allocate): this kernel is the conservative
+            # parity oracle; heterogeneous-profile leftovers go unplaced
+            # here and are retried next session
             jq = a["job_queue"][jidx]
             valid = valid & le_fits(qalloc[jq] + req_acct, deserved[jq],
                                     thr, scalar_mask, ignore_req=req_acct)

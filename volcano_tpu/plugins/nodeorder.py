@@ -87,14 +87,17 @@ class NodeOrderPlugin(Plugin):
                             # match every pod
                             continue
                         # k8s scopes the term to its namespaces list, or
-                        # the incoming pod's namespace by default
+                        # the incoming pod's namespace by default, and
+                        # scores weight PER matching existing pod (a node
+                        # holding 3 matches outranks one holding 1)
                         namespaces = set(term.get("namespaces")
                                          or [pod.namespace])
-                        if any(p.namespace in namespaces
-                               and all((p.labels or {}).get(k) == v
-                                       for k, v in sel.items())
-                               for p in on_node):
-                            pa_score += sign * weight
+                        matches = sum(
+                            1 for p in on_node
+                            if p.namespace in namespaces
+                            and all((p.labels or {}).get(k) == v
+                                    for k, v in sel.items()))
+                        pa_score += sign * weight * matches
             return (self.least_requested * least
                     + self.most_requested * most
                     + self.balanced * balanced
