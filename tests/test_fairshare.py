@@ -383,3 +383,35 @@ class TestHeterogeneousQueueProfiles:
             assert (placed_c, placed_m) == (8, 7), (placed_c, placed_m)
         else:  # faithful reference stranding: stop just past deserved
             assert placed_c == 5 and placed_m == 5, (placed_c, placed_m)
+
+
+class TestCapabilityQuota:
+    @pytest.mark.parametrize("mode", ["solver", "host"])
+    def test_overflow_never_exceeds_capability(self, mode):
+        """The work-conserving overflow pass relaxes fair-share deserved
+        but NEVER the hard capability quota. Solver mode stops exactly at
+        the 4-cpu capability; host mode reproduces the reference's
+        between-picks overused check, which lets the crossing allocation
+        through (5) before stopping — both bounded, solver the stricter."""
+        from volcano_tpu.conf import Configuration
+        from volcano_tpu.framework import get_action
+
+        queues = [build_queue("q1", weight=1,
+                              capability={"cpu": "4", "memory": "100Gi"})]
+        pgs = [build_pod_group("pg1", queue="q1", min_member=1)]
+        pods = [build_pod("default", f"a{i}", "", "Pending",
+                          {"cpu": "1", "memory": "1Gi"}, "pg1")
+                for i in range(8)]
+        nodes = [build_node("n1", {"cpu": "8", "memory": "100Gi"})]
+        store, cache = make_cluster(nodes, pgs, pods, queues=queues)
+        tiers = [Tier(plugins=[PluginOption(name="gang")]),
+                 Tier(plugins=[PluginOption(name="proportion"),
+                               PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers,
+                           [Configuration("allocate", {"mode": mode})])
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        expected = 4 if mode == "solver" else 5
+        assert len(cache.binder.binds) == expected, \
+            sorted(cache.binder.binds)

@@ -192,7 +192,7 @@ def run_evict_solver(ssn, mode: str):
     arr = flatten_snapshot(
         {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
         queues=ssn.queues,
-        cache=getattr(ssn, "evict_flatten_cache", None),
+        cache=getattr(ssn, "evict_flatten_caches", {}).get(mode),
         grouped=job_order)
     victims = collect_victims(ssn, arr.nodes_list)
     if not victims:

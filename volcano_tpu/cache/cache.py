@@ -262,10 +262,11 @@ class SchedulerCache:
         from ..ops.arrays import FlattenCache
         from ..ops.device_cache import PackedDeviceCache
         self.flatten_cache = FlattenCache()
-        # a separate cache for preempt/reclaim flattens: their task sets
-        # differ per call, and sharing one cache would clobber the allocate
-        # flatten's wholesale fast-path key every cycle
-        self.evict_flatten_cache = FlattenCache()
+        # separate caches for preempt/reclaim flattens: each action's task
+        # set differs from allocate's AND from the other's, and sharing a
+        # cache clobbers the wholesale fast-path key every cycle
+        self.evict_flatten_caches = {"preempt": FlattenCache(),
+                                     "reclaim": FlattenCache()}
         # device-resident packed solver buffers (delta-shipped per session)
         self.device_cache = PackedDeviceCache()
         # optional solver-sidecar client (parallel.sidecar.SidecarSolver):
@@ -494,7 +495,9 @@ class SchedulerCache:
 
     def _finalize_expired_evictions(self) -> None:
         now = time.time()
-        for job in self.jobs.values():
+        # materialize: deleting a pod can drop its job from self.jobs via
+        # the delete listener while we iterate
+        for job in list(self.jobs.values()):
             for task in list(job.task_status_index.get(
                     TaskStatus.RELEASING, {}).values()):
                 pod = self.cluster.try_get("pods", task.name,

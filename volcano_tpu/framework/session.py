@@ -91,8 +91,8 @@ class Session:
         self.score_params = ScoreParams()
         self.solver_options: Dict[str, object] = {}
         self.flatten_cache = getattr(cache, "flatten_cache", None)
-        self.evict_flatten_cache = getattr(cache, "evict_flatten_cache",
-                                           None)
+        self.evict_flatten_caches = getattr(cache, "evict_flatten_caches",
+                                            None) or {}
         self.device_cache = getattr(cache, "device_cache", None)
         self.sidecar = getattr(cache, "sidecar", None)
 

@@ -256,12 +256,12 @@ def queue_cap_state(a, rank, thr, total):
     deserved = water_fill_deserved(
         total, a["queue_weight"], a["queue_capability"],
         a["queue_request"], thr, max_iters=q + 1)
-    # dims a queue never requested must not bind its cap: the reference's
-    # overused check (proportion.go overusedFn: deserved.LessEqual(
-    # allocated)) can never trip on a dim the queue's workloads don't use,
-    # so e.g. a cpu-only queue is not throttled at its (meaningless)
-    # memory deserved. Water-filled deserved on such dims is replaced by
-    # +inf for the per-round caps.
+    # dims a queue never requested must not bind its cap: a queue whose
+    # workloads don't use a dim should not be throttled at its
+    # (meaningless) water-filled deserved there, so those dims are
+    # replaced by +inf for the per-round caps. (This is one of two
+    # deliberate strandings-avoidance improvements over the reference's
+    # any-dim overused rule; see phase_rounds' overflow pass.)
     deserved = jnp.where(a["queue_request"] > thr[None, :],
                          deserved, jnp.inf)
     task_queue = a["job_queue"][a["task_job"]]
@@ -485,11 +485,12 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         """Run admission rounds to fixpoint against idle (allocate) or
         future-idle (pipeline). st: 9-tuple carry (idle, pipe, npods,
         qalloc, jobres, assigned, kind, excluded, rounds). capped=False is
-        the work-conserving overflow pass: queue fair-share caps are
-        relaxed so capacity no competing queue wants is not stranded (the
-        reference's overused check binds only when a queue saturates its
-        deserved on EVERY dim, so it under-enforces rather than strand —
-        proportion.go overusedFn)."""
+        the work-conserving overflow pass: fair-share deserved caps are
+        relaxed (hard capability quotas still bind) so capacity no
+        competing queue wants is not stranded. This deliberately improves
+        on the reference, whose any-dim overused check
+        (proportion.go:245 `!allocated.LessEqual(deserved)`) strands the
+        same capacity — the host path reproduces that faithfully."""
 
         def cond(s):
             changed, rounds = s[-1], s[-2]
@@ -507,8 +508,13 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 eligible = drf_cap(eligible, jobres)
             else:
                 r_rank = rank
-            if use_queue_cap and capped:
-                qrem = jnp.maximum(deserved - qalloc, 0.0)
+            if use_queue_cap:
+                # capped phases enforce fair-share deserved; the overflow
+                # pass relaxes deserved but NEVER the hard capability
+                # quota (a queue must not exceed its capability just
+                # because capacity is otherwise idle)
+                bound = deserved if capped else a["queue_capability"]
+                qrem = jnp.maximum(bound - qalloc, 0.0)
                 qp = (jnp.lexsort((r_rank, task_queue)) if use_drf_order
                       else q_perm)
                 eligible = eligible & _queue_cap_mask(

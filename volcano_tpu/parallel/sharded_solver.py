@@ -220,7 +220,7 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 jnp.where(admit, choice, -1), "n")                # [T]
             return new_assign, debit, pod_inc
 
-        def phase_rounds(st, use_future):
+        def phase_rounds(st, use_future, capped=True):
             def cond(s):
                 return s[-1] & (s[-2] < max_rounds)
 
@@ -237,7 +237,9 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 else:
                     r_rank = rank
                 if use_queue_cap:
-                    qrem = jnp.maximum(deserved - qalloc, 0.0)
+                    # overflow pass relaxes deserved, never capability
+                    bound = deserved if capped else a["queue_capability"]
+                    qrem = jnp.maximum(bound - qalloc, 0.0)
                     qp = (jnp.lexsort((r_rank, task_queue))
                           if use_drf_order else q_perm)
                     eligible = eligible & _queue_cap_mask(
@@ -277,6 +279,10 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                   excluded, rounds)
             st = phase_rounds(st, False)
             st = phase_rounds(st, True)
+            if use_queue_cap:
+                # work-conserving overflow (see ops/solver.py phase_rounds)
+                st = phase_rounds(st, False, capped=False)
+                st = phase_rounds(st, True, capped=False)
             (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
              rounds) = st
             alloc_counts = jax.ops.segment_sum(
