@@ -324,3 +324,45 @@ class TestFlattenCache:
         gi = arr.vocab.index("nvidia.com/gpu")
         assert gi is not None
         assert arr.task_init_req[1, gi] == 2000.0  # scalars are milli-units
+
+
+class TestFusedDelta:
+    """solve_allocate_delta (scatter fused into the solve dispatch) must
+    match solve_allocate on the same snapshot, across churned sessions."""
+
+    def test_fused_matches_plain_across_sessions(self):
+        from volcano_tpu.ops import FlattenCache, PackedDeviceCache
+        from volcano_tpu.ops.solver import solve_allocate_delta
+
+        jobs, nodes, tasks = make_problem(
+            [(f"n{i}", "8", "16Gi") for i in range(6)],
+            [(f"j{k}", 2, [("1", "1Gi")] * 3) for k in range(5)])
+        fc, dc = FlattenCache(), PackedDeviceCache(chunk=64)
+        node_list = list(nodes.values())
+
+        for s in range(3):
+            # churn: dirty one node row via real accounting
+            if s:
+                from volcano_tpu.api import TaskInfo
+                p = build_pod("ns", f"runner-{s}", node_list[s].name,
+                              "Running", {"cpu": "1", "memory": "1Gi"}, "j0")
+                t = TaskInfo(p)
+                t.status = TaskStatus.RUNNING
+                node_list[s].add_task(t)
+            arr = flatten_snapshot(jobs, nodes, tasks, cache=fc)
+            p = params_dict(arr, least_req_weight=1.0)
+            ref = solve_allocate(arr.device_dict(), p)
+            fbuf, ibuf, layout = arr.packed()
+            f2d, i2d, fi, fv, ii, iv = dc.plan_delta(fbuf, ibuf, layout)
+            res, nf, ni = solve_allocate_delta(
+                f2d, i2d, fi, fv, ii, iv, layout, p,
+                score_families=("binpack", "kube"))
+            dc.commit(nf, ni)
+            np.testing.assert_array_equal(np.asarray(res.assigned),
+                                          np.asarray(ref.assigned))
+            np.testing.assert_array_equal(np.asarray(res.kind),
+                                          np.asarray(ref.kind))
+            if s:
+                # steady state ships a delta, not the full buffers
+                total = (dc._host_f.size + dc._host_i.size) // dc.chunk
+                assert dc.last_shipped_chunks < total

@@ -30,6 +30,8 @@ from ..utils import PriorityQueue
 
 log = logging.getLogger(__name__)
 
+_UNRESOLVED = object()  # sentinel: _pending_tasks resolves the key itself
+
 
 def build_score_inputs(ssn, arr):
     """Resolve the session's plugin score weights against this flatten's
@@ -68,7 +70,16 @@ class AllocateAction(Action):
     def _ordered_jobs(self, ssn):
         """Yield schedulable jobs in namespace -> queue -> job order,
         skipping Pending-phase podgroups, invalid jobs, unknown queues and
-        overused queues (allocate.go:61-160)."""
+        overused queues (allocate.go:61-160).
+
+        When every active job-order plugin registered a key extractor the
+        per-queue ordering is ONE sort by composite key instead of O(n log
+        n) comparator dispatches — equivalent here because solver-mode
+        collection happens before any session mutation, so the keys
+        (shares, readiness) are frozen for its duration."""
+        queue_factory = ssn.keyed_job_queue_factory() \
+            or (lambda: PriorityQueue(ssn.job_order_fn))
+
         namespaces = PriorityQueue(ssn.namespace_order_fn)
         jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
 
@@ -84,8 +95,7 @@ class AllocateAction(Action):
             if ns not in jobs_map:
                 jobs_map[ns] = {}
                 namespaces.push(ns)
-            jobs_map[ns].setdefault(
-                job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+            jobs_map[ns].setdefault(job.queue, queue_factory()).push(job)
 
         while not namespaces.empty():
             ns = namespaces.pop()
@@ -114,13 +124,26 @@ class AllocateAction(Action):
             yield job
             namespaces.push(ns)
 
-    def _pending_tasks(self, ssn, job) -> List:
+    def _pending_tasks(self, ssn, job, taskkey=_UNRESOLVED) -> List:
         """Pending, non-best-effort tasks in task order
-        (allocate.go:175-189)."""
+        (allocate.go:175-189). ``taskkey`` is the composite task-order key
+        (resolve once per action via ssn.composite_order_key and pass it in
+        for multi-job loops; None falls back to comparator sorting)."""
+        pending = [
+            t for t in job.task_status_index.get(
+                TaskStatus.PENDING, {}).values()
+            if not t.resreq.is_empty()  # BestEffort tasks are backfill's
+        ]
+        if taskkey is _UNRESOLVED:
+            taskkey = ssn.composite_order_key("task_order_fns")
+        if taskkey is not None:
+            def full_key(t):
+                ct = t.pod.creation_timestamp
+                return (taskkey(t), ct is not None, ct or 0, t.uid)
+            pending.sort(key=full_key)
+            return pending
         pq = PriorityQueue(ssn.task_order_fn)
-        for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-            if task.resreq.is_empty():
-                continue  # BestEffort tasks are backfill's
+        for task in pending:
             pq.push(task)
         out = []
         while not pq.empty():
@@ -132,16 +155,21 @@ class AllocateAction(Action):
     # ------------------------------------------------------------------
 
     def _execute_solver(self, ssn, sequential: bool = False) -> None:
+        import time as _time
+
         from ..ops import flatten_snapshot, solve_allocate, \
             solve_allocate_sequential
 
+        timing = ssn.solver_options.setdefault("timing", {})
+        t0 = _time.perf_counter()
         host_only = ssn.solver_options.get("host_only_jobs") or ()
+        taskkey = ssn.composite_order_key("task_order_fns")
         job_order = []
         tasks_in_order = []
         for job in self._ordered_jobs(ssn):
             if job.uid in host_only:
                 continue  # routed through the host loop after the solve
-            tasks = self._pending_tasks(ssn, job)
+            tasks = self._pending_tasks(ssn, job, taskkey)
             if tasks:
                 job_order.append((job, tasks))
                 tasks_in_order.extend(tasks)
@@ -187,6 +215,8 @@ class AllocateAction(Action):
                         attr.allocated.to_vector(arr.vocab)
             arr.drf_total = drf_opts["total"].to_vector(arr.vocab)
 
+        timing["flatten_ms"] = (_time.perf_counter() - t0) * 1e3
+        t0 = _time.perf_counter()
         params, families = build_score_inputs(ssn, arr)
         herd = ssn.solver_options.get("herd_mode")
         if herd is None:
@@ -210,14 +240,24 @@ class AllocateAction(Action):
                 use_drf_order=use_drf_order)
             res = None
         elif dc is not None:
-            # device-resident buffers: per-session upload = dirty chunks only
-            from ..ops.solver import solve_allocate_packed2d
+            # device-resident buffers, fused dispatch: the dirty-chunk
+            # scatter runs INSIDE the solve jit, so a session costs exactly
+            # one dispatch (scatter+solve) + one compact readback
+            from ..ops.solver import solve_allocate_delta
             fbuf, ibuf, layout = arr.packed()
-            f2d, i2d = dc.update(fbuf, ibuf, layout)
-            res = solve_allocate_packed2d(
-                f2d, i2d, layout, params, herd_mode=herd,
-                score_families=families, use_queue_cap=use_queue_cap,
-                use_drf_order=use_drf_order)
+            f2d, i2d, fi, fv, ii, iv = dc.plan_delta(fbuf, ibuf, layout)
+            try:
+                res, new_f, new_i = solve_allocate_delta(
+                    f2d, i2d, fi, fv, ii, iv, layout, params,
+                    herd_mode=herd, score_families=families,
+                    use_queue_cap=use_queue_cap,
+                    use_drf_order=use_drf_order)
+            except Exception:
+                # donation may have consumed the buffers: drop the mirror
+                # so the next session re-ships in full
+                dc.reset()
+                raise
+            dc.commit(new_f, new_i)
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
@@ -233,11 +273,15 @@ class AllocateAction(Action):
             else:  # >16k nodes: node index overflows the int16 packing
                 assigned = np.asarray(res.assigned)
                 kind = np.asarray(res.kind)
+        timing["solve_ms"] = (_time.perf_counter() - t0) * 1e3
+        t0 = _time.perf_counter()
 
-        # replay through the Statement boundary in job order
+        # replay through the Statement boundary in job order; events fire
+        # as one batch per committed job (identical final handler state —
+        # handlers are additive — at a tenth of the per-task cost)
         idx = 0
         for job, tasks in job_order:
-            stmt = ssn.statement()
+            stmt = ssn.statement(defer_events=True)
             for task in tasks:
                 t_idx = idx
                 idx += 1
@@ -263,6 +307,7 @@ class AllocateAction(Action):
                 stmt.commit()
             else:
                 stmt.discard()
+        timing["replay_ms"] = (_time.perf_counter() - t0) * 1e3
 
     @staticmethod
     def _fill_queue_arrays(arr, queue_opts, ssn) -> None:

@@ -29,6 +29,10 @@ class EnqueueAction(Action):
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_set = set()
         jobs_map = {}
+        # ordering keys are frozen during enqueue (nothing allocates), so
+        # the key-sorted queue applies whenever the plugins provide keys
+        job_queue_factory = ssn.keyed_job_queue_factory() \
+            or (lambda: PriorityQueue(ssn.job_order_fn))
 
         import time
         for job in ssn.jobs.values():
@@ -42,7 +46,7 @@ class EnqueueAction(Action):
                 queues.push(queue)
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 jobs_map.setdefault(
-                    job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+                    job.queue, job_queue_factory()).push(job)
 
         total, used = Resource(), Resource()
         for node in ssn.nodes.values():

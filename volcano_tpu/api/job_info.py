@@ -186,8 +186,24 @@ class JobInfo:
 
     def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
         """Delete + reinsert keeping index/aggregates consistent
-        (job_info.go:207-224)."""
-        if ti.key in self.tasks:
+        (job_info.go:207-224). When ti IS the stored object (the hot
+        replay/bind path) the reinsert collapses to an index move plus the
+        allocated-aggregate delta — total_request is invariant under a
+        status change, so the sub/add pair is skipped."""
+        stored = self.tasks.get(ti.key)
+        if stored is ti:
+            was = allocated_status(ti.status)
+            self._remove_from_index(ti)
+            ti.status = status
+            self._add_to_index(ti)
+            now = allocated_status(status)
+            if was and not now:
+                self.allocated.sub(ti.resreq)
+            elif now and not was:
+                self.allocated.add(ti.resreq)
+            self.flat_version = next_flat_version()
+            return
+        if stored is not None:
             self.delete_task_info(ti)
         ti.status = status
         self.add_task_info(ti)
@@ -236,8 +252,22 @@ class JobInfo:
         j.creation_timestamp = self.creation_timestamp
         j.schedule_start_timestamp = self.schedule_start_timestamp
         j.job = self.job
-        for ti in self.tasks.values():
-            j.add_task_info(ti.clone())
+        # bulk form of add_task_info: the indexes are rebuilt wholesale and
+        # the aggregates copied instead of re-summed per task — the snapshot
+        # clone fan-out is the scheduler's per-cycle floor, so this path is
+        # deliberately allocation-lean (cache.go:693-742 clones in a
+        # 16-goroutine pool for the same reason)
+        tasks = {k: ti.clone() for k, ti in self.tasks.items()}
+        j.tasks = tasks
+        index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        for k, ti in tasks.items():
+            bucket = index.get(ti.status)
+            if bucket is None:
+                index[ti.status] = bucket = {}
+            bucket[k] = ti
+        j.task_status_index = index
+        j.allocated = self.allocated.clone()
+        j.total_request = self.total_request.clone()
         # a clone is the same logical state: carry the version so the
         # per-session snapshot clone keeps the flatten cache warm
         j.flat_version = self.flat_version

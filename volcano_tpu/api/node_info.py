@@ -150,9 +150,12 @@ class NodeInfo:
     # -- task accounting ----------------------------------------------------
 
     def _allocate_idle(self, ti: TaskInfo) -> None:
-        if not ti.resreq.less_equal(self.idle):
+        # sub() itself asserts less_equal; wrapping avoids paying the
+        # check twice on the hot path
+        try:
+            self.idle.sub(ti.resreq)
+        except ValueError:
             raise ValueError("selected node NotReady")
-        self.idle.sub(ti.resreq)
 
     def add_task(self, task: TaskInfo) -> None:
         """Status-dependent accounting (node_info.go:224-266). The node keeps

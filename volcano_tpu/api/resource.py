@@ -94,8 +94,12 @@ class Resource:
         return r
 
     def clone(self) -> "Resource":
-        return Resource(self.milli_cpu, self.memory, dict(self.scalars),
-                        self.max_task_num)
+        r = Resource.__new__(Resource)  # skip __init__'s re-coercions
+        r.milli_cpu = self.milli_cpu
+        r.memory = self.memory
+        r.scalars = dict(self.scalars)
+        r.max_task_num = self.max_task_num
+        return r
 
     # -- predicates ---------------------------------------------------------
 
@@ -235,18 +239,24 @@ class Resource:
     def less_equal(self, rr: "Resource") -> bool:
         """Threshold-tolerant <= (resource_info.go LessEqual): a dimension
         passes if l < r or |l-r| < min-threshold; scalar dims below the
-        threshold are ignored entirely."""
-        def le(l, r, diff):
-            return l < r or abs(l - r) < diff
-        if not le(self.milli_cpu, rr.milli_cpu, MIN_MILLI_CPU):
+        threshold are ignored entirely. (Comparisons inlined — this is the
+        single hottest host function at 10k tasks/cycle.)"""
+        l = self.milli_cpu
+        r = rr.milli_cpu
+        if l >= r and abs(l - r) >= MIN_MILLI_CPU:
             return False
-        if not le(self.memory, rr.memory, MIN_MEMORY):
+        l = self.memory
+        r = rr.memory
+        if l >= r and abs(l - r) >= MIN_MEMORY:
             return False
-        for k, v in self.scalars.items():
-            if v <= MIN_MILLI_SCALAR:
-                continue
-            if not le(v, rr.scalars.get(k, 0.0), MIN_MILLI_SCALAR):
-                return False
+        if self.scalars:
+            rs = rr.scalars
+            for k, v in self.scalars.items():
+                if v <= MIN_MILLI_SCALAR:
+                    continue
+                r = rs.get(k, 0.0)
+                if v >= r and abs(v - r) >= MIN_MILLI_SCALAR:
+                    return False
         return True
 
     def __eq__(self, other) -> bool:

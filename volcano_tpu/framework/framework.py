@@ -54,6 +54,7 @@ def close_session(ssn: Session) -> None:
     ssn.nodes = {}
     ssn.plugins = {}
     ssn.event_handlers = []
+    ssn._tier_cache = {}
     for reg in list(ssn.__dict__):
         if reg.endswith("_fns"):
             setattr(ssn, reg, {})

@@ -62,27 +62,33 @@ class PackedDeviceCache:
         self._dev_f = self._dev_i = None
         self._layout = None
 
-    def update(self, fbuf: np.ndarray, ibuf: np.ndarray,
-               layout) -> Tuple[object, object]:
+    # -- shared mirror maintenance (update + plan_delta flows) ----------
+
+    def _full_ship(self, fbuf, ibuf, layout, cf: int, ci: int):
+        """(Re)establish the host mirror and device buffers wholesale."""
         import jax
 
         c = self.chunk
-        cf = -(-max(fbuf.size, 1) // c)
-        ci = -(-max(ibuf.size, 1) // c)
-        if (self._layout != layout or self._host_f is None
-                or self._host_f.size != cf * c
-                or self._host_i.size != ci * c):
-            hf = np.zeros(cf * c, np.float32)
-            hf[:fbuf.size] = fbuf
-            hi = np.zeros(ci * c, np.int32)
-            hi[:ibuf.size] = ibuf
-            self._host_f, self._host_i = hf, hi
-            self._dev_f = jax.device_put(hf.reshape(cf, c))
-            self._dev_i = jax.device_put(hi.reshape(ci, c))
-            self._layout = layout
-            self.last_shipped_chunks = cf + ci
-            return self._dev_f, self._dev_i
+        hf = np.zeros(cf * c, np.float32)
+        hf[:fbuf.size] = fbuf
+        hi = np.zeros(ci * c, np.int32)
+        hi[:ibuf.size] = ibuf
+        self._host_f, self._host_i = hf, hi
+        self._dev_f = jax.device_put(hf.reshape(cf, c))
+        self._dev_i = jax.device_put(hi.reshape(ci, c))
+        self._layout = layout
+        self.last_shipped_chunks = cf + ci
 
+    def _needs_full_ship(self, layout, cf: int, ci: int) -> bool:
+        c = self.chunk
+        return (self._layout != layout or self._host_f is None
+                or self._host_f.size != cf * c
+                or self._host_i.size != ci * c)
+
+    def _diff(self, fbuf, ibuf, cf: int, ci: int):
+        """Pad new content into mirror-shaped buffers and locate dirty
+        chunks: (f2, i2, df, di). Does NOT update the mirror."""
+        c = self.chunk
         f2 = np.zeros_like(self._host_f)
         f2[:fbuf.size] = fbuf
         i2 = np.zeros_like(self._host_i)
@@ -92,6 +98,18 @@ class PackedDeviceCache:
         di = np.nonzero((i2.reshape(ci, c)
                          != self._host_i.reshape(ci, c)).any(axis=1))[0]
         self.last_shipped_chunks = int(df.size + di.size)
+        return f2, i2, df, di
+
+    def update(self, fbuf: np.ndarray, ibuf: np.ndarray,
+               layout) -> Tuple[object, object]:
+        c = self.chunk
+        cf = -(-max(fbuf.size, 1) // c)
+        ci = -(-max(ibuf.size, 1) // c)
+        if self._needs_full_ship(layout, cf, ci):
+            self._full_ship(fbuf, ibuf, layout, cf, ci)
+            return self._dev_f, self._dev_i
+
+        f2, i2, df, di = self._diff(fbuf, ibuf, cf, ci)
         try:
             new_f = self._apply(self._dev_f, df, f2.reshape(cf, c))
             new_i = self._apply(self._dev_i, di, i2.reshape(ci, c))
@@ -115,3 +133,57 @@ class PackedDeviceCache:
         pad = np.full(k, idx[0], np.int32)
         pad[:idx.size] = idx.astype(np.int32)
         return _scatter(dev, pad, host2d[pad])
+
+    # ------------------------------------------------------------------
+    # fused-dispatch flow: plan the delta, let the SOLVE jit apply it
+    # (ops.solver.solve_allocate_delta), then commit the returned buffers
+    # ------------------------------------------------------------------
+
+    def plan_delta(self, fbuf: np.ndarray, ibuf: np.ndarray, layout):
+        """Diff against the host mirror WITHOUT dispatching: returns
+        (f2d, i2d, f_idx, f_vals, i_idx, i_vals) ready for
+        solve_allocate_delta, which scatters the dirty chunks inside the
+        solve dispatch itself. The host mirror is updated eagerly; on a
+        dispatch failure the caller must call reset() so the next session
+        re-ships in full (commit() is only bookkeeping for the donated
+        buffers the solve returns).
+
+        On the first call (or a layout change) the full buffers are
+        device_put and a no-op delta (chunk 0 rewritten with identical
+        bytes) is returned, so the caller has a single code path.
+        """
+        c = self.chunk
+        cf = -(-max(fbuf.size, 1) // c)
+        ci = -(-max(ibuf.size, 1) // c)
+        if self._needs_full_ship(layout, cf, ci):
+            self._full_ship(fbuf, ibuf, layout, cf, ci)
+            zero = np.zeros(1, np.int32)
+            return (self._dev_f, self._dev_i,
+                    zero, self._host_f.reshape(cf, c)[:1],
+                    zero, self._host_i.reshape(ci, c)[:1])
+
+        f2, i2, df, di = self._diff(fbuf, ibuf, cf, ci)
+        # one shared bucket for both index arrays: a distinct (|f_idx|,
+        # |i_idx|) shape pair would compile a distinct variant of the whole
+        # fused solve, so the variant count must stay log(chunks), not
+        # log^2
+        k = _pow2_bucket(max(int(df.size), int(di.size), 1))
+        f_idx = self._pad_idx(df, k)
+        i_idx = self._pad_idx(di, k)
+        self._host_f, self._host_i = f2, i2
+        return (self._dev_f, self._dev_i,
+                f_idx, f2.reshape(cf, c)[f_idx],
+                i_idx, i2.reshape(ci, c)[i_idx])
+
+    @staticmethod
+    def _pad_idx(idx: np.ndarray, k: int) -> np.ndarray:
+        """Chunk indices padded to k (duplicates write identical values so
+        the pad is a no-op scatter)."""
+        pad = np.full(k, idx[0] if idx.size else 0, np.int32)
+        pad[:idx.size] = idx.astype(np.int32)
+        return pad
+
+    def commit(self, f2d, i2d) -> None:
+        """Store the buffers returned by solve_allocate_delta (the inputs
+        were donated and are now invalid)."""
+        self._dev_f, self._dev_i = f2d, i2d

@@ -833,6 +833,44 @@ def solve_allocate_packed2d(f2d, i2d, layout,
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
+    "score_families", "use_queue_cap", "use_drf_order"),
+    donate_argnums=(0, 1))
+def solve_allocate_delta(f2d, i2d, f_idx, f_vals, i_idx, i_vals, layout,
+                         score_params: Dict[str, jnp.ndarray],
+                         max_rounds: int = 64,
+                         max_gang_iters: int = 8,
+                         per_node_cap: int = 0,
+                         herd_mode: str = "pack",
+                         score_families: Tuple[str, ...] = ("binpack",),
+                         use_queue_cap: bool = False,
+                         use_drf_order: bool = False):
+    """Fused dirty-chunk scatter + solve: the whole session is ONE device
+    dispatch (this call) plus ONE readback (res.compact) — on a
+    latency-expensive tunnel the dispatch count IS the latency, so the
+    delta upload (ops.device_cache) rides the solve's argument transfer
+    instead of paying its own two scatter dispatches.
+
+    f2d/i2d are the donated device-resident chunked buffers; f_idx/f_vals
+    (and i_idx/i_vals) are the dirty chunk indices and replacement chunk
+    contents (duplicate indices write identical values, so power-of-two
+    padding is a no-op). Returns (result, new_f2d, new_i2d) — the caller
+    must retain the returned buffers (donation invalidates the inputs).
+    """
+    f2d = f2d.at[f_idx].set(f_vals)
+    i2d = i2d.at[i_idx].set(i_vals)
+    nf = max(off + size for k, kind, off, size, shape in layout
+             if kind == "f")
+    ni = max(off + size for k, kind, off, size, shape in layout
+             if kind != "f")
+    arrays = _unpack(f2d.reshape(-1)[:nf], i2d.reshape(-1)[:ni], layout)
+    res = solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
+                         per_node_cap, herd_mode, score_families,
+                         use_queue_cap, use_drf_order)
+    return res, f2d, i2d
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
     "score_families", "use_queue_cap", "use_drf_order"))
 def solve_allocate_packed(fbuf, ibuf, layout,
                           score_params: Dict[str, jnp.ndarray],

@@ -382,6 +382,8 @@ class DRFPlugin(Plugin):
             return -1 if ls < rs else 1
 
         ssn.add_job_order_fn(self.name(), job_order_fn)
+        ssn.add_order_key_fn("job_order_fns", self.name(),
+                             lambda j: self.job_attrs[j.uid].share)
 
         if namespace_order:
             def namespace_order_fn(l, r):
@@ -449,8 +451,38 @@ class DRFPlugin(Plugin):
                         self.hierarchical_root, self.total_allocated, job,
                         attr, queue.hierarchy, queue.weights)
 
+        def on_allocate_batch(tasks):
+            """Additive form of on_allocate: one aggregate add + one share
+            recompute per job (shares depend only on totals)."""
+            by_job: Dict[str, Resource] = {}
+            for t in tasks:
+                agg = by_job.get(t.job)
+                if agg is None:
+                    by_job[t.job] = agg = Resource()
+                agg.add(t.resreq)
+            for juid, agg in by_job.items():
+                attr = self.job_attrs.get(juid)
+                if attr is None:
+                    continue
+                attr.allocated.add(agg)
+                job = ssn.jobs.get(juid)
+                self._update_job_share(job.namespace, job.name, attr)
+                if namespace_order:
+                    ns_opt = self.namespace_opts.setdefault(
+                        job.namespace, _DrfAttr())
+                    ns_opt.allocated.add(agg)
+                    self._update_namespace_share(job.namespace, ns_opt)
+                if hierarchy:
+                    queue = ssn.queues.get(job.queue)
+                    if queue is not None:
+                        self.total_allocated.add(agg)
+                        self.update_hierarchical_share(
+                            self.hierarchical_root, self.total_allocated,
+                            job, attr, queue.hierarchy, queue.weights)
+
         ssn.add_event_handler(EventHandler(
-            allocate_func=on_allocate, deallocate_func=on_deallocate))
+            allocate_func=on_allocate, deallocate_func=on_deallocate,
+            batch_allocate_func=on_allocate_batch))
 
     def on_session_close(self, ssn) -> None:
         self.total_resource = Resource()

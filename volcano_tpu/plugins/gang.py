@@ -58,6 +58,9 @@ class GangPlugin(Plugin):
             return 0
 
         ssn.add_job_order_fn(self.name(), job_order_fn)
+        # key form of the comparator: unready (False) sorts before ready
+        ssn.add_order_key_fn("job_order_fns", self.name(),
+                             lambda j: j.ready())
         ssn.add_job_ready_fn(self.name(), lambda job: job.ready())
         ssn.add_job_pipelined_fn(self.name(), lambda job: job.pipelined())
 

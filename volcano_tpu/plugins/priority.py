@@ -19,6 +19,8 @@ class PriorityPlugin(Plugin):
             return -1 if l.priority > r.priority else 1
 
         ssn.add_task_order_fn(self.name(), task_order_fn)
+        ssn.add_order_key_fn("task_order_fns", self.name(),
+                             lambda t: -t.priority)
 
         def job_order_fn(l, r):
             if l.priority == r.priority:
@@ -26,6 +28,8 @@ class PriorityPlugin(Plugin):
             return -1 if l.priority > r.priority else 1
 
         ssn.add_job_order_fn(self.name(), job_order_fn)
+        ssn.add_order_key_fn("job_order_fns", self.name(),
+                             lambda j: -j.priority)
 
         def preemptable_fn(preemptor, preemptees):
             """Victims must belong to strictly lower-priority jobs."""

@@ -216,8 +216,28 @@ class ProportionPlugin(Plugin):
                 pass
             self._update_share(attr)
 
+        def on_allocate_batch(tasks):
+            """Additive form: one aggregate add + one share recompute per
+            queue (share depends only on the allocated total)."""
+            by_queue: Dict[str, Resource] = {}
+            for t in tasks:
+                job = ssn.jobs.get(t.job)
+                if job is None:
+                    continue
+                agg = by_queue.get(job.queue)
+                if agg is None:
+                    by_queue[job.queue] = agg = Resource()
+                agg.add(t.resreq)
+            for qname, agg in by_queue.items():
+                attr = self.queue_opts.get(qname)
+                if attr is None:
+                    continue
+                attr.allocated.add(agg)
+                self._update_share(attr)
+
         ssn.add_event_handler(EventHandler(
-            allocate_func=on_allocate, deallocate_func=on_deallocate))
+            allocate_func=on_allocate, deallocate_func=on_deallocate,
+            batch_allocate_func=on_allocate_batch))
 
     def on_session_close(self, ssn) -> None:
         self.total_resource = Resource()

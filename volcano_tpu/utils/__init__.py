@@ -4,7 +4,7 @@ from .assert_util import AssertionFailed, assert_, assertf  # noqa: F401
 from .leader_election import (  # noqa: F401
     LeaderElector, Lease, LeaseLock,
 )
-from .priority_queue import PriorityQueue  # noqa: F401
+from .priority_queue import KeySortedQueue, PriorityQueue  # noqa: F401
 from .scheduler_helper import (  # noqa: F401
     NodeSampler, ResourceReservation, reservation, validate_victims,
 )

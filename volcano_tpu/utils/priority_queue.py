@@ -30,6 +30,48 @@ class PriorityQueue:
         return len(self._heap)
 
 
+class KeySortedQueue:
+    """PriorityQueue-shaped wrapper over ONE key-based sort.
+
+    Valid only while the ordering keys are frozen (no session mutation
+    between pushes and pops) — solver-mode collection and the enqueue
+    action qualify; the host allocate loop, whose comparators read live
+    shares, does not. Replaces O(n log n) comparator dispatches (each a
+    tier walk over plugin fns) with a single C-speed sort."""
+
+    __slots__ = ("_key", "_items", "_sorted", "_pos")
+
+    def __init__(self, key: Callable[[Any], Any]):
+        self._key = key
+        self._items = []
+        self._sorted = False
+        self._pos = 0
+
+    def push(self, item) -> None:
+        if self._sorted:  # a post-sort push re-opens the list
+            self._items = self._items[self._pos:]
+            self._sorted = False
+            self._pos = 0
+        self._items.append(item)
+
+    def pop(self):
+        if not self._sorted:
+            self._items.sort(key=self._key)
+            self._sorted = True
+            self._pos = 0
+        if self._pos >= len(self._items):
+            return None
+        item = self._items[self._pos]
+        self._pos += 1
+        return item
+
+    def empty(self) -> bool:
+        return self._pos >= len(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items) - self._pos
+
+
 class _Entry:
     __slots__ = ("item", "seq", "less")
 
