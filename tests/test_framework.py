@@ -301,3 +301,66 @@ class TestNodeSampling:
         s.advance(700, 1000)  # scan walked 700 nodes to find 100 feasible
         second, _ = s.plan(nodes)
         assert second[0] == 700  # next scan starts where the last stopped
+
+
+class TestJobUpdaterDirtySkip:
+    """The skip-if-untouched fast path must not miss changes landing
+    BETWEEN sessions (informer pod updates) or unready jobs whose
+    Unschedulable conditions post unconditionally."""
+
+    def _cluster(self):
+        from volcano_tpu.cache import FakeEvictor, SchedulerCache
+        from volcano_tpu.client import ClusterStore
+        from volcano_tpu.scheduler import Scheduler
+
+        store = ClusterStore()
+        cache = SchedulerCache(store)
+        cache.evictor = FakeEvictor()
+        cache.run()
+        store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        pg = build_pod_group("j1", "ns", min_member=2)
+        store.create("podgroups", pg)
+        for i in range(2):
+            store.create("pods", build_pod("ns", f"j1-{i}", "", "Pending",
+                                           {"cpu": "1", "memory": "1Gi"},
+                                           "j1"))
+        return store, cache, Scheduler(cache)
+
+    def test_pod_succeeding_between_cycles_updates_status(self):
+        store, cache, sched = self._cluster()
+        sched.run_once()  # binds both pods (default binder -> Running)
+        sched.run_once()  # steady cycle: status settles, versions recorded
+        pg = store.get("podgroups", "j1", "ns")
+        assert pg.status.running == 2
+
+        # a pod succeeds between cycles (informer-driven, no session touch)
+        pod = store.get("pods", "j1-0", "ns")
+        pod.phase = "Succeeded"
+        store.update("pods", pod)
+        sched.run_once()
+        pg = store.get("podgroups", "j1", "ns")
+        assert pg.status.succeeded == 1, \
+            "between-cycle pod completion must re-dirty the job"
+        assert pg.status.running == 1
+
+    def test_untouched_unschedulable_job_keeps_getting_conditions(self):
+        from volcano_tpu.cache import FakeEvictor, SchedulerCache
+        from volcano_tpu.client import ClusterStore
+        from volcano_tpu.scheduler import Scheduler
+
+        store = ClusterStore()
+        cache = SchedulerCache(store)
+        cache.evictor = FakeEvictor()
+        cache.run()
+        store.create("nodes", build_node("n1", {"cpu": "1", "memory": "2Gi"}))
+        pg = build_pod_group("big", "ns", min_member=4)
+        store.create("podgroups", pg)
+        for i in range(4):
+            store.create("pods", build_pod("ns", f"big-{i}", "", "Pending",
+                                           {"cpu": "1", "memory": "1Gi"},
+                                           "big"))
+        sched = Scheduler(cache)
+        sched.run_once()
+        sched.run_once()  # the job stays unready; conditions must re-post
+        pod = store.get("pods", "big-0", "ns")
+        assert any(c.get("type") == "PodScheduled" for c in pod.conditions)

@@ -33,6 +33,12 @@ log = logging.getLogger(__name__)
 _UNRESOLVED = object()  # sentinel: _pending_tasks resolves the key itself
 
 
+def _task_order_key(ssn):
+    """Full task-order key (pod creation-timestamp tiebreak) or None."""
+    return ssn.full_order_key("task_order_fns",
+                              ct_of=lambda t: t.pod.creation_timestamp)
+
+
 def build_score_inputs(ssn, arr):
     """Resolve the session's plugin score weights against this flatten's
     vocab/shape: (params dict for ops.score_matrix, static families tuple)."""
@@ -126,21 +132,18 @@ class AllocateAction(Action):
 
     def _pending_tasks(self, ssn, job, taskkey=_UNRESOLVED) -> List:
         """Pending, non-best-effort tasks in task order
-        (allocate.go:175-189). ``taskkey`` is the composite task-order key
-        (resolve once per action via ssn.composite_order_key and pass it in
-        for multi-job loops; None falls back to comparator sorting)."""
+        (allocate.go:175-189). ``taskkey`` is the full task-order key
+        (resolve once per action via ssn.full_order_key and pass it in for
+        multi-job loops; None falls back to comparator sorting)."""
         pending = [
             t for t in job.task_status_index.get(
                 TaskStatus.PENDING, {}).values()
             if not t.resreq.is_empty()  # BestEffort tasks are backfill's
         ]
         if taskkey is _UNRESOLVED:
-            taskkey = ssn.composite_order_key("task_order_fns")
+            taskkey = _task_order_key(ssn)
         if taskkey is not None:
-            def full_key(t):
-                ct = t.pod.creation_timestamp
-                return (taskkey(t), ct is not None, ct or 0, t.uid)
-            pending.sort(key=full_key)
+            pending.sort(key=taskkey)
             return pending
         pq = PriorityQueue(ssn.task_order_fn)
         for task in pending:
@@ -163,7 +166,7 @@ class AllocateAction(Action):
         timing = ssn.solver_options.setdefault("timing", {})
         t0 = _time.perf_counter()
         host_only = ssn.solver_options.get("host_only_jobs") or ()
-        taskkey = ssn.composite_order_key("task_order_fns")
+        taskkey = _task_order_key(ssn)
         job_order = []
         tasks_in_order = []
         for job in self._ordered_jobs(ssn):

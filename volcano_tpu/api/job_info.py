@@ -98,8 +98,12 @@ class TaskInfo:
         t.priority = self.priority
         t.volume_ready = self.volume_ready
         t.pod = self.pod
-        t.resreq = self.resreq.clone()
-        t.init_resreq = self.init_resreq.clone()
+        # resreq/init_resreq are read-only after construction (every
+        # consumer passes them as the rr side of Resource add/sub or calls
+        # pure predicates), so clones share them — the snapshot clone
+        # fan-out at 10k tasks is the scheduler's per-cycle host floor
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
         t.sig_cache = self.sig_cache
         return t
 
@@ -134,6 +138,9 @@ class JobInfo:
         self.flat_version = 0
         self.allocated = Resource()
         self.total_request = Resource()
+        # maintained sum of PENDING tasks' resreq: lets per-cycle plugin
+        # opens (proportion's request attr) be O(jobs) instead of O(tasks)
+        self.pending_request = Resource()
         self.nodes_fit_errors: Dict[str, FitErrors] = {}
         # Plugin-readiness bookkeeping (job controller plugins)
         self.job = None  # batch Job CR when known
@@ -171,6 +178,8 @@ class JobInfo:
         self._add_to_index(ti)
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)
+        elif ti.status == TaskStatus.PENDING:
+            self.pending_request.add(ti.resreq)
         self.total_request.add(ti.resreq)
 
     def delete_task_info(self, ti: TaskInfo) -> None:
@@ -179,6 +188,11 @@ class JobInfo:
             raise KeyError(f"failed to find task <{ti.key}> in job <{self.uid}>")
         if allocated_status(task.status):
             self.allocated.sub(task.resreq)
+        elif task.status == TaskStatus.PENDING:
+            try:
+                self.pending_request.sub(task.resreq)
+            except ValueError:
+                self.pending_request = Resource()
         self.total_request.sub(task.resreq)
         del self.tasks[task.key]
         self._remove_from_index(task)
@@ -192,7 +206,8 @@ class JobInfo:
         status change, so the sub/add pair is skipped."""
         stored = self.tasks.get(ti.key)
         if stored is ti:
-            was = allocated_status(ti.status)
+            old = ti.status
+            was = allocated_status(old)
             self._remove_from_index(ti)
             ti.status = status
             self._add_to_index(ti)
@@ -201,6 +216,13 @@ class JobInfo:
                 self.allocated.sub(ti.resreq)
             elif now and not was:
                 self.allocated.add(ti.resreq)
+            if old == TaskStatus.PENDING and status != TaskStatus.PENDING:
+                try:
+                    self.pending_request.sub(ti.resreq)
+                except ValueError:
+                    self.pending_request = Resource()
+            elif status == TaskStatus.PENDING and old != TaskStatus.PENDING:
+                self.pending_request.add(ti.resreq)
             self.flat_version = next_flat_version()
             return
         if stored is not None:
@@ -268,6 +290,7 @@ class JobInfo:
         j.task_status_index = index
         j.allocated = self.allocated.clone()
         j.total_request = self.total_request.clone()
+        j.pending_request = self.pending_request.clone()
         # a clone is the same logical state: carry the version so the
         # per-session snapshot clone keeps the flatten cache warm
         j.flat_version = self.flat_version

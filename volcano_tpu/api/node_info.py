@@ -217,8 +217,12 @@ class NodeInfo:
         n.capability = self.capability.clone()
         n.others = dict(self.others)
         n.gpu_devices = {i: d.clone() for i, d in self.gpu_devices.items()}
-        for k, t in self.tasks.items():
-            n.tasks[k] = t.clone()
+        # node-held TaskInfo entries are replace-only: add_task stores a
+        # private clone and every later change goes through
+        # remove_task/update_task (object replacement), never in-place
+        # mutation — so clones share the entries. This halves the snapshot
+        # clone fan-out, the scheduler's per-cycle host floor.
+        n.tasks = dict(self.tasks)
         n.flat_version = self.flat_version
         n.spec_version = self.spec_version
         n.flat_epoch = self.flat_epoch

@@ -274,6 +274,11 @@ class SchedulerCache:
         # of running the kernel in-process
         self.sidecar = None
 
+        # job uid -> flat_version reflected by the last successful status
+        # write; the job updater's skip-if-untouched check compares against
+        # this (NOT session open) so inter-session informer changes count
+        self.updater_versions: Dict[str, int] = {}
+
         self._create_default_queue()
 
     # -- startup ------------------------------------------------------------
@@ -417,6 +422,7 @@ class SchedulerCache:
         job = self.jobs.get(ti.job)
         if job is not None and not job.tasks and job.pod_group is None:
             del self.jobs[ti.job]
+            self.updater_versions.pop(ti.job, None)
 
     # -- node handlers ------------------------------------------------------
 
@@ -449,6 +455,7 @@ class SchedulerCache:
         job.pod_group = None
         if not job.tasks:
             del self.jobs[key]
+            self.updater_versions.pop(key, None)
 
     def add_queue(self, queue: Queue) -> None:
         self.queues[queue.name] = QueueInfo(queue)

@@ -22,10 +22,10 @@ class Event:
 class EventHandler:
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
-    # optional bulk forms: handlers whose per-task updates are additive
+    # optional bulk form: handlers whose per-task updates are additive
     # (drf/proportion share accounting) can process a whole job's
     # assignments in one call; the session falls back to the per-task fn
     # when absent. Used by the solver-mode replay, where firing 10k
-    # individual events dominated the cycle profile.
+    # individual events dominated the cycle profile. (No deallocate
+    # counterpart: deferred statements fire nothing on discard.)
     batch_allocate_func: Optional[Callable[[list], None]] = None
-    batch_deallocate_func: Optional[Callable[[list], None]] = None

@@ -89,6 +89,11 @@ class Session:
                 failed=job.pod_group.status.failed)
             for uid, job in self.jobs.items() if job.pod_group is not None
         }
+        # change tracking for the job updater's skip-if-untouched fast
+        # path: open-time flat_versions plus condition writes
+        self._open_versions = {uid: job.flat_version
+                               for uid, job in self.jobs.items()}
+        self._conditions_touched = set()
 
         for reg in FN_REGISTRIES:
             setattr(self, reg, {})
@@ -144,20 +149,32 @@ class Session:
             keyfns.append(kf)
         return lambda item: tuple(kf(item) for kf in keyfns)
 
+    def full_order_key(self, registry: str,
+                       ct_of: Callable = None) -> Optional[Callable]:
+        """Composite plugin key + the creation-timestamp/uid tiebreak that
+        the comparator dispatchers apply after plugin ties (job_order_fn /
+        task_order_fn), as ONE key function; None when some provider has
+        no registered key."""
+        key = self.composite_order_key(registry)
+        if key is None:
+            return None
+        if ct_of is None:
+            ct_of = lambda item: item.creation_timestamp  # noqa: E731
+
+        def full_key(item):
+            ct = ct_of(item)
+            return (key(item), ct is not None, ct or 0, item.uid)
+
+        return full_key
+
     def keyed_job_queue_factory(self) -> Optional[Callable]:
-        """Factory for KeySortedQueue job queues (plugin keys + the
-        creation-timestamp/uid tiebreak of job_order_fn), or None when a
-        job-order plugin lacks a key and callers must keep comparator
+        """Factory for KeySortedQueue job queues, or None when a job-order
+        plugin lacks a key and callers must keep comparator
         PriorityQueues."""
         from ..utils import KeySortedQueue
-        jobkey = self.composite_order_key("job_order_fns")
-        if jobkey is None:
+        full_key = self.full_order_key("job_order_fns")
+        if full_key is None:
             return None
-
-        def full_key(j):
-            ct = j.creation_timestamp
-            return (jobkey(j), ct is not None, ct or 0, j.uid)
-
         return lambda: KeySortedQueue(full_key)
 
     def add_job_order_fn(self, name, fn): self._add("job_order_fns", name, fn)
@@ -443,8 +460,17 @@ class Session:
         conds = job.pod_group.status.conditions
         for i, c in enumerate(conds):
             if c.type == cond.type:
+                # only a significant change dirties the job for the
+                # updater — same significance rule as its
+                # _conditions_equal (transition_id/time don't count), so
+                # gang's steady per-cycle re-post of an identical
+                # Scheduled condition doesn't force 1k no-op recomputes
+                if (c.status, c.reason, c.message) != (
+                        cond.status, cond.reason, cond.message):
+                    self._conditions_touched.add(job.uid)
                 conds[i] = cond
                 return
+        self._conditions_touched.add(job.uid)
         conds.append(cond)
 
     def __str__(self) -> str:

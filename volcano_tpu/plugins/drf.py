@@ -242,11 +242,10 @@ class DRFPlugin(Plugin):
         hierarchy = self._hierarchy_enabled(ssn)
 
         for job in ssn.jobs.values():
-            attr = _DrfAttr()
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
+            # JobInfo.allocated is the maintained sum over allocated-status
+            # tasks — the same set drf.go:201-214 iterates — so the session
+            # open is O(jobs), not O(tasks)
+            attr = _DrfAttr(job.allocated.clone())
             self._update_job_share(job.namespace, job.name, attr)
             self.job_attrs[job.uid] = attr
 

@@ -71,14 +71,12 @@ class ProportionPlugin(Plugin):
                         queue.capability)
                 self.queue_opts[job.queue] = attr
             attr = self.queue_opts[job.queue]
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
-                        attr.request.add(t.resreq)
-                elif status == TaskStatus.PENDING:
-                    for t in tasks.values():
-                        attr.request.add(t.resreq)
+            # maintained aggregates (job_info) replace the per-task loops of
+            # proportion.go:120-134: allocated = allocated-status sum,
+            # request = allocated + pending sums — O(jobs) per session open
+            attr.allocated.add(job.allocated)
+            attr.request.add(job.allocated)
+            attr.request.add(job.pending_request)
             if job.pod_group.status.phase == PodGroupPhase.INQUEUE:
                 attr.inqueue.add(Resource.from_resource_list(
                     job.pod_group.spec.min_resources or {}))

@@ -73,17 +73,29 @@ class Scheduler:
         self.load_conf()
         ssn = open_session(self.cache, self.tiers, self.configurations)
         ssn.node_sampler = self.node_sampler
+        timing = {}
+        t_open = time.perf_counter()
+        timing["open_ms"] = (t_open - t0) * 1e3
         try:
             for action in self.actions:
                 ta = time.perf_counter()
                 action.execute(ssn)
+                dt = time.perf_counter() - ta
+                timing[f"{action.name()}_ms"] = dt * 1e3
                 metrics.action_scheduling_latency.observe(
-                    (time.perf_counter() - ta) * 1e6,
-                    labels={"action": action.name()})
+                    dt * 1e6, labels={"action": action.name()})
+            # the allocate action's internal decomposition when it ran in
+            # solver mode (flatten/solve/replay)
+            for k, v in (ssn.solver_options.get("timing") or {}).items():
+                timing[k] = v
         finally:
+            tc = time.perf_counter()
             close_session(ssn)
-        metrics.e2e_scheduling_latency.observe(
-            (time.perf_counter() - t0) * 1e3)
+            timing["close_ms"] = (time.perf_counter() - tc) * 1e3
+        total = (time.perf_counter() - t0) * 1e3
+        timing["total_ms"] = total
+        self.last_cycle_timing = timing
+        metrics.e2e_scheduling_latency.observe(total)
 
     def run_with_leader_election(self, stop, lock_name: str = "volcano",
                                  identity: Optional[str] = None) -> None:
