@@ -30,12 +30,14 @@ from volcano_tpu.ops.solver import (
 T, N, J, Q, R, S = 64, 16, 16, 4, 2, 4
 
 #: per-case floor on rounds-solver placements relative to the sequential
-#: reference. The waterfall heuristic's mean-request slot estimate can
-#: misroute heterogeneous task mixes on tiny clusters (accepted
-#: greedy-order deviation; observed worst 0.37 across 160 seeded cases —
-#: at bench scale, config #2 shows the rounds solver PLACING MORE than the
-#: reference). A regression below this floor means a real bug, not noise.
-PLACEMENT_SLACK = 0.33
+#: reference. With the deferred-retry gang queue (doubly-reverted jobs
+#: retry one at a time in rank order) and near-best-score striping, the
+#: observed worst case across 160 seeds is 0.667 — and those cases are
+#: job-SUBSET choices under extreme contention (e.g. 4-vs-6 placements
+#: with identical job_ready counts), not placements lost to heuristic
+#: scatter; the aggregate is >1.0 (the rounds solver places MORE).
+#: A regression below this floor means a real bug, not noise.
+PLACEMENT_SLACK = 0.65
 
 CASES = 40
 
@@ -209,8 +211,10 @@ def test_contended_parity(herd, queue_cap):
             (f"case {case} ({herd}, qcap={queue_cap}): rounds placed {p1} "
              f"vs sequential {p2}")
     # in aggregate the production solver stays within a few percent of the
-    # reference greedy on adversarial small cases (and beats it at scale)
-    assert total_rounds >= total_seq * 0.92, (total_rounds, total_seq)
+    # reference greedy per config (observed floor 0.972 on pack/no-cap;
+    # summed across all four herd/queue-cap configs it places MORE than
+    # the reference, ratio ~1.035)
+    assert total_rounds >= total_seq * 0.95, (total_rounds, total_seq)
 
 
 @pytest.mark.parametrize("queue_cap", [False, True])

@@ -296,3 +296,107 @@ class TestElectReserve:
         assert "n2" in reservation.locked_nodes
         close_session(ssn)
         reservation.reset()
+
+
+class TestEvictionMinimality:
+    """BENCH config #4 shape, scaled down: eviction count must track the
+    analytic minimum (spend free capacity everywhere before killing)."""
+
+    def test_uniform_gang_near_minimal_evictions(self):
+        import numpy as np
+
+        from volcano_tpu.api import JobInfo, NodeInfo, TaskInfo
+        from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+        from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
+        from volcano_tpu.ops import bucket, flatten_snapshot
+        from volcano_tpu.ops.evict import (
+            decode_evict_compact, solve_evict_uniform,
+        )
+        from volcano_tpu.ops.arrays import ScoreParams
+
+        # 20 nodes x 16 cpu; 10 x 1-cpu victims each (future idle = 6);
+        # 100 claimers of 2 cpu. Analytic minimum: 5 claimers/node =
+        # 3 free + 2 via evicting 4 victims -> 20 x 4 = 80 evictions.
+        n_nodes, n_victims, n_claim = 20, 200, 100
+        nodes = {}
+        for i in range(n_nodes):
+            rl = {"cpu": "16", "memory": "64Gi", "pods": 110}
+            nodes[f"n{i}"] = NodeInfo(Node(name=f"n{i}", allocatable=rl,
+                                           capacity=dict(rl)))
+        low = JobInfo("ns/low", PodGroup(name="low", namespace="ns",
+                                         spec=PodGroupSpec(min_member=1)))
+        victims = []
+        for i in range(n_victims):
+            pod = Pod(name=f"low-{i}", namespace="ns",
+                      node_name=f"n{i % n_nodes}", phase="Running",
+                      annotations={POD_GROUP_ANNOTATION: "low"},
+                      containers=[{"requests": {"cpu": "1",
+                                                "memory": "2Gi"}}])
+            t = TaskInfo(pod)
+            t.status = TaskStatus.RUNNING
+            low.add_task_info(t)
+            nodes[f"n{i % n_nodes}"].add_task(t)
+            victims.append(t)
+        hi = JobInfo("ns/hi", PodGroup(name="hi", namespace="ns",
+                                       spec=PodGroupSpec(min_member=n_claim)))
+        claimers = []
+        for i in range(n_claim):
+            pod = Pod(name=f"hi-{i}", namespace="ns",
+                      annotations={POD_GROUP_ANNOTATION: "hi"},
+                      containers=[{"requests": {"cpu": "2",
+                                                "memory": "4Gi"}}])
+            t = TaskInfo(pod)
+            hi.add_task_info(t)
+            claimers.append(t)
+
+        arr = flatten_snapshot({hi.uid: hi}, nodes, claimers)
+        sp = ScoreParams(least_req_weight=1.0).resolved(arr.R, arr.N)
+        params = {
+            "binpack_weight": np.float32(sp.binpack_weight),
+            "binpack_res_weights": sp.binpack_res_weights,
+            "least_req_weight": np.float32(sp.least_req_weight),
+            "most_req_weight": np.float32(sp.most_req_weight),
+            "balanced_weight": np.float32(sp.balanced_weight),
+            "node_static": sp.node_static,
+        }
+        node_index = {n.name: i for i, n in enumerate(arr.nodes_list)}
+        ordered = sorted(victims, key=lambda t: node_index[t.node_name])
+        V = bucket(len(ordered))
+        J = arr.job_min.shape[0]
+        v_req = np.zeros((V, arr.R), np.float32)
+        v_node = np.zeros(V, np.int32)
+        v_valid = np.zeros(V, bool)
+        for i, t in enumerate(ordered):
+            v_req[i] = t.resreq.to_vector(arr.vocab)
+            v_node[i] = node_index[t.node_name]
+            v_valid[i] = True
+        elig = np.zeros((J, V), bool)
+        elig[0, :len(ordered)] = True
+        need = np.zeros(J, np.int32)
+        need[0] = n_claim
+        job_req = np.zeros((J, arr.R), np.float32)
+        job_req[0] = arr.task_init_req[0]
+        job_acct = np.zeros((J, arr.R), np.float32)
+        job_acct[0] = arr.task_req[0]
+        job_count = np.zeros(J, np.int32)
+        job_count[0] = n_claim
+        varrays = {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
+                   "elig": elig, "job_need": need, "job_req": job_req,
+                   "job_acct": job_acct, "job_count": job_count}
+        res = solve_evict_uniform(arr.device_dict(), varrays, params)
+        assigned, evicted_by = decode_evict_compact(
+            res.compact, arr.task_init_req.shape[0])
+        placed = int((assigned[:n_claim] >= 0).sum())
+        evictions = int((evicted_by >= 0).sum())
+        assert placed == n_claim
+        # capacity check: per node, demand must fit idle + freed
+        demand = np.zeros(arr.N)
+        for i in range(n_claim):
+            demand[assigned[i]] += 2000.0
+        freed = np.zeros(arr.N)
+        for v in np.nonzero(evicted_by >= 0)[0]:
+            freed[v_node[v]] += v_req[v][0]
+        idle0 = arr.node_idle[:, 0]
+        assert (demand <= idle0 + freed + 1e-3).all()
+        # minimality: analytic minimum is 80; allow 10% slack
+        assert evictions <= 88, f"evictions {evictions} vs minimum 80"

@@ -189,10 +189,7 @@ class JobInfo:
         if allocated_status(task.status):
             self.allocated.sub(task.resreq)
         elif task.status == TaskStatus.PENDING:
-            try:
-                self.pending_request.sub(task.resreq)
-            except ValueError:
-                self.pending_request = Resource()
+            self.pending_request.sub(task.resreq)
         self.total_request.sub(task.resreq)
         del self.tasks[task.key]
         self._remove_from_index(task)
@@ -217,10 +214,7 @@ class JobInfo:
             elif now and not was:
                 self.allocated.add(ti.resreq)
             if old == TaskStatus.PENDING and status != TaskStatus.PENDING:
-                try:
-                    self.pending_request.sub(ti.resreq)
-                except ValueError:
-                    self.pending_request = Resource()
+                self.pending_request.sub(ti.resreq)
             elif status == TaskStatus.PENDING and old != TaskStatus.PENDING:
                 self.pending_request.add(ti.resreq)
             self.flat_version = next_flat_version()

@@ -89,10 +89,9 @@ class Session:
                 failed=job.pod_group.status.failed)
             for uid, job in self.jobs.items() if job.pod_group is not None
         }
-        # change tracking for the job updater's skip-if-untouched fast
-        # path: open-time flat_versions plus condition writes
-        self._open_versions = {uid: job.flat_version
-                               for uid, job in self.jobs.items()}
+        # jobs whose podgroup conditions changed significantly this
+        # session (update_pod_group_condition); one of the job updater's
+        # dirty signals
         self._conditions_touched = set()
 
         for reg in FN_REGISTRIES:

@@ -308,22 +308,75 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
         m_back = jnp.maximum(m - 1.0, 0.0)
         m = jnp.where(fits_m(m), m,
                       jnp.where(fits_m(m_back), m_back, 0.0))
-        m = jnp.where(job_feas[j] & a["node_valid"] & has_v, m, 0.0)
-        m = m.astype(jnp.int32)
+        feas_n = job_feas[j] & a["node_valid"]
+        m = jnp.where(feas_n & has_v, m, 0.0)
 
-        total = jnp.sum(m)
+        # free slots (claimers a node absorbs with NO eviction): same
+        # floor + le_fits validation against the un-freed base. Victimless
+        # feasible nodes count here — eviction minimality means spending
+        # idle capacity before killing anything.
+        per_dim_f = jnp.where(
+            sig[None, :],
+            jnp.floor(base / jnp.maximum(r, 1e-9)), jnp.inf)
+        f_n = jnp.min(per_dim_f, axis=1)
+        f_n = jnp.clip(jnp.nan_to_num(f_n, posinf=float(T)), 0.0, float(T))
+
+        def fits_f(mm):
+            return le_fits(mm[:, None] * r_fit[None, :], base, thr, sm,
+                           ignore_req=r[None, :])
+
+        f_back = jnp.maximum(f_n - 1.0, 0.0)
+        f_n = jnp.where(fits_f(f_n), f_n,
+                        jnp.where(fits_f(f_back), f_back, 0.0))
+        f_n = jnp.where(feas_n, f_n, 0.0)
+        # node capacity: victims-freed max where victims exist, free slots
+        # elsewhere (m already includes the node's free capacity)
+        m_all = jnp.where(has_v, jnp.maximum(m, f_n), f_n)
+        cap_extra = jnp.maximum(m_all - f_n, 0.0)   # slots costing evictions
+
+        total = jnp.sum(m_all).astype(jnp.int32)
         # gang: need `need[j]` pipelines; if unreachable place nothing
         satisfied = (total >= need[j]) if stop_at_need else jnp.bool_(True)
         do = active & satisfied & (total > 0)
         count = jnp.where(do, jnp.minimum(count, total), 0)
 
-        # spread claimers over nodes in score order
-        order = jnp.argsort(-jnp.where(m > 0, job_score[j], NEG))  # [N]
-        m_o = m[order]
-        cum = jnp.cumsum(m_o)
-        prev = cum - m_o
-        c_o = jnp.clip(count - prev, 0, m_o)                       # [N]
-        c = jnp.zeros(N, jnp.int32).at[order].set(c_o)             # [N]
+        # ---- eviction-minimal spread (preempt.go:219-240 evicts the
+        # cheapest prefix per preemptor; the batched equivalent is: fill
+        # free capacity first, then waterfill the remainder evenly so no
+        # node over-evicts while another sits on idle victims) ----
+        score_j = jnp.where(m_all > 0, job_score[j], NEG)
+        order = jnp.argsort(-score_j)                              # [N]
+        # phase 1: free slots in score order
+        f_o = f_n[order]
+        cum_f = jnp.cumsum(f_o)
+        c_free_o = jnp.clip(count.astype(jnp.float32) - (cum_f - f_o),
+                            0.0, f_o)
+        c_free = jnp.zeros(N, jnp.float32).at[order].set(c_free_o)
+        D = jnp.maximum(count.astype(jnp.float32) - jnp.sum(c_free), 0.0)
+        # phase 2: waterfill level l* = smallest l with
+        # sum(min(cap_extra, l)) >= D, then trim the surplus from the
+        # lowest-scoring at-level nodes
+        srt = jnp.sort(cap_extra)                                  # asc
+        csum = jnp.cumsum(srt)
+        S = csum + srt * (N - 1 - jnp.arange(N, dtype=jnp.float32))
+        found = jnp.any(S >= D)
+        i0 = jnp.argmax(S >= D)
+        csum_prev = jnp.where(i0 > 0, csum[jnp.maximum(i0 - 1, 0)], 0.0)
+        seg = jnp.maximum((N - i0).astype(jnp.float32), 1.0)
+        lvl = jnp.ceil((D - csum_prev) / seg)
+        lvl = jnp.where(found, jnp.maximum(lvl, 0.0),
+                        jnp.max(cap_extra, initial=0.0))
+        c_extra = jnp.minimum(cap_extra, lvl)
+        surplus = jnp.maximum(jnp.sum(c_extra) - D, 0.0)
+        at_level = (c_extra >= lvl) & (lvl > 0)
+        trim_order = jnp.argsort(jnp.where(at_level, score_j, jnp.inf))
+        trim_pos = jnp.zeros(N, jnp.int32).at[trim_order].set(
+            jnp.arange(N, dtype=jnp.int32))
+        c_extra = c_extra - (at_level
+                             & (trim_pos < surplus)).astype(jnp.float32)
+        c = (c_free + c_extra).astype(jnp.int32)                   # [N]
+        # task->node mapping order: cumulative placements in score order
+        cum = jnp.cumsum(c[order]).astype(jnp.float32)
 
         # task -> node: claimer position p lands on the node where the
         # score-ordered cumulative count first exceeds p

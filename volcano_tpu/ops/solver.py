@@ -335,7 +335,9 @@ def _waterfall_choice(eligible, feas, masked, fit_req, avail, npods,
     """
     T, N = feas.shape
     node_score = jnp.max(masked, axis=0)                            # [N]
-    # mean eligible request estimates per-node slot counts
+    # mean eligible request estimates per-node slot counts (the estimate
+    # only steers TARGETING — prefix admission is exact; quantile
+    # estimators were tried and lose to the mean across the parity corpus)
     n_elig = jnp.maximum(jnp.sum(eligible), 1)
     mean_req = jnp.sum(fit_req * eligible[:, None], axis=0) / n_elig  # [R]
     sig = mean_req > jnp.where(scalar_mask, 10.0, 0.0)
@@ -352,7 +354,16 @@ def _waterfall_choice(eligible, feas, masked, fit_req, avail, npods,
     slots_o = slots[order]
     pos = jnp.cumsum(eligible.astype(jnp.int32)) - 1                # [T]
     if mode == "spread":
-        m = jnp.maximum(jnp.sum(has_slot), 1)
+        # stripe only across nodes whose herd score ties the best:
+        # sequential least-requested alternates between EQUAL nodes but
+        # keeps filling a strictly-better node until another catches up,
+        # so striping across unequal nodes would scatter a gang the
+        # reference packs (and revert it under contention)
+        masked_score = jnp.where(has_slot, node_score, NEG)
+        best_s = jnp.max(masked_score)
+        eps = 1e-5 * jnp.maximum(jnp.abs(best_s), 1.0)
+        near = has_slot & (masked_score >= best_s - eps)
+        m = jnp.maximum(jnp.sum(near), 1)
         target = order[jnp.mod(jnp.maximum(pos, 0), m)]
     else:
         cum = jnp.cumsum(slots_o)
@@ -431,7 +442,7 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
 def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    score_params: Dict[str, jnp.ndarray],
                    max_rounds: int = 64,
-                   max_gang_iters: int = 8,
+                   max_gang_iters: int = 12,
                    per_node_cap: int = 0,
                    herd_mode: str = "pack",
                    score_families: Tuple[str, ...] = ("binpack", "kube"),
@@ -559,11 +570,25 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         out = jax.lax.while_loop(cond, body, st + (any_eligible,))
         return out[:-1]
 
+    # job order position for the gang-exclusion tie-break: first valid
+    # task's rank (static snapshot order)
+    job_first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(
+        jnp.where(a["task_valid"], rank, T))
+
     def gang_body(s):
         (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
-         rounds, _, it, reverted_once) = s
-        st = (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
-              rounds)
+         rounds, _, it, revert_count, deferred, processed) = s
+        # deferred-retry queue: jobs that reverted twice in the parallel
+        # phases sit out while the best-ranked of them retries ALONE —
+        # the batched equivalent of the sequential reference, where the
+        # earliest discarded gang gets first claim on capacity later
+        # discards free. One deferred job resolves per iteration.
+        unproc = deferred & ~processed & ~excluded
+        cur = jnp.argmin(jnp.where(unproc, job_first_rank, BIG_KEY))
+        solo = unproc & (jnp.arange(J) == cur)
+        barred = deferred & ~solo
+        st = (idle, pipe, npods, qalloc, jobres, assigned, kind,
+              excluded | barred, rounds)
         st = phase_rounds(st, use_future=False)
         st = phase_rounds(st, use_future=True)
         if use_queue_cap:
@@ -571,7 +596,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             # take under its cap go to whoever still wants them
             st = phase_rounds(st, use_future=False, capped=False)
             st = phase_rounds(st, use_future=True, capped=False)
-        (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+        (idle, pipe, npods, qalloc, jobres, assigned, kind, _masked,
          rounds) = st
 
         # gang check: allocated (kind 0, counts_ready) per job
@@ -589,7 +614,8 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         has_alloc = jax.ops.segment_sum(
             ((assigned >= 0) & (kind == 0)).astype(jnp.int32), a["task_job"],
             num_segments=J) > 0
-        revert_job = ~ready & a["job_valid"] & ~excluded & has_alloc
+        revert_job = ~ready & a["job_valid"] & ~excluded & ~barred \
+            & has_alloc
         revert_task = (revert_job[a["task_job"]] & (assigned >= 0)
                        & (kind == 0))
         credit = jax.ops.segment_sum(
@@ -610,28 +636,36 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 num_segments=J)
         assigned = jnp.where(revert_task, -1, assigned)
         kind = jnp.where(revert_task, -1, kind)
-        # one retry per job: a first revert leaves the job eligible for the
-        # next gang iteration (another job's revert — often the cause of its
-        # failure — may have freed room); a second revert excludes it for
-        # good, keeping the fixpoint bounded
-        excluded = excluded | (revert_job & reverted_once)
-        reverted_once = reverted_once | revert_job
-        any_revert = jnp.any(revert_job)
+        # retry policy: a first revert leaves the job eligible for the
+        # next parallel iteration (another job's revert — often the cause
+        # of its failure — may have freed room); a second revert defers
+        # the job to the one-at-a-time queue above. A solo retry that
+        # reverts again is excluded for good; either way the job counts
+        # as processed, so the queue drains one job per iteration and the
+        # fixpoint stays bounded.
+        revert_count = revert_count + revert_job.astype(jnp.int32)
+        excluded = excluded | (solo & revert_job)
+        processed = processed | (solo & jnp.any(unproc))
+        deferred = deferred | (revert_job & (revert_count >= 2))
+        any_more = jnp.any(revert_job) | jnp.any(
+            deferred & ~processed & ~excluded)
         return (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
-                rounds, any_revert, it + 1, reverted_once)
+                rounds, any_more, it + 1, revert_count, deferred, processed)
 
     init = (a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"],
             qalloc0, jobres0,
             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
             ~a["job_valid"], jnp.int32(0), jnp.bool_(True), jnp.int32(0),
+            jnp.zeros(J, jnp.int32), jnp.zeros(J, dtype=bool),
             jnp.zeros(J, dtype=bool))
     # bounded gang fixpoint: rerun phases while any job got reverted (its
-    # freed resources may admit other jobs)
+    # freed resources may admit other jobs) or deferred jobs await their
+    # solo retry
     s = jax.lax.while_loop(
-        lambda s: s[-3] & (s[-2] < max_gang_iters), gang_body, init)
+        lambda s: s[-5] & (s[-4] < max_gang_iters), gang_body, init)
 
     (idle, pipe, npods, _, _, assigned, kind, excluded, rounds,
-     _, _, _) = s
+     _, _, _, _, _) = s
     alloc_counts = jax.ops.segment_sum(
         ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
         a["task_job"], num_segments=J)
@@ -810,7 +844,7 @@ def _unpack(fbuf, ibuf, layout):
 def solve_allocate_packed2d(f2d, i2d, layout,
                             score_params: Dict[str, jnp.ndarray],
                             max_rounds: int = 64,
-                            max_gang_iters: int = 8,
+                            max_gang_iters: int = 12,
                             per_node_cap: int = 0,
                             herd_mode: str = "pack",
                             score_families: Tuple[str, ...] = ("binpack",),
@@ -838,7 +872,7 @@ def solve_allocate_packed2d(f2d, i2d, layout,
 def solve_allocate_delta(f2d, i2d, f_idx, f_vals, i_idx, i_vals, layout,
                          score_params: Dict[str, jnp.ndarray],
                          max_rounds: int = 64,
-                         max_gang_iters: int = 8,
+                         max_gang_iters: int = 12,
                          per_node_cap: int = 0,
                          herd_mode: str = "pack",
                          score_families: Tuple[str, ...] = ("binpack",),
@@ -875,7 +909,7 @@ def solve_allocate_delta(f2d, i2d, f_idx, f_vals, i_idx, i_vals, layout,
 def solve_allocate_packed(fbuf, ibuf, layout,
                           score_params: Dict[str, jnp.ndarray],
                           max_rounds: int = 64,
-                          max_gang_iters: int = 8,
+                          max_gang_iters: int = 12,
                           per_node_cap: int = 0,
                           herd_mode: str = "pack",
                           score_families: Tuple[str, ...] = ("binpack",),
