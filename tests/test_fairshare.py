@@ -415,3 +415,57 @@ class TestCapabilityQuota:
         expected = 4 if mode == "solver" else 5
         assert len(cache.binder.binds) == expected, \
             sorted(cache.binder.binds)
+
+
+class TestHDRFKernel:
+    """The hdrf rescaling scenario through the SOLVER path: the in-kernel
+    hierarchical re-rank (ops.hdrf) must reproduce the host outcome."""
+
+    def test_rescaling_solver_mode(self):
+        queues = [
+            build_queue("root-sci", annotations={
+                "volcano.sh/hierarchy": "root/sci",
+                "volcano.sh/hierarchy-weights": "100/50"}),
+            build_queue("root-eng-dev", annotations={
+                "volcano.sh/hierarchy": "root/eng/dev",
+                "volcano.sh/hierarchy-weights": "100/50/50"}),
+            build_queue("root-eng-prod", annotations={
+                "volcano.sh/hierarchy": "root/eng/prod",
+                "volcano.sh/hierarchy-weights": "100/50/50"}),
+        ]
+        pgs = [build_pod_group("pg1", queue="root-sci", min_member=1),
+               build_pod_group("pg21", queue="root-eng-dev", min_member=1),
+               build_pod_group("pg22", queue="root-eng-prod", min_member=1)]
+        pods = []
+        for i in range(10):
+            pods.append(build_pod("default", f"pg1-p{i}", "", "Pending",
+                                  {"cpu": "1", "memory": "1G"}, "pg1"))
+            pods.append(build_pod("default", f"pg21-p{i}", "", "Pending",
+                                  {"cpu": "1", "memory": "0"}, "pg21"))
+            pods.append(build_pod("default", f"pg22-p{i}", "", "Pending",
+                                  {"cpu": "0", "memory": "1G"}, "pg22"))
+        nodes = [build_node("n", {"cpu": "10", "memory": "10G"})]
+        store, cache = make_cluster(nodes, pgs, pods, queues)
+        tiers = [Tier(plugins=[
+            PluginOption(name="drf",
+                         arguments={"drf.enableHierarchy": True}),
+            PluginOption(name="gang"),
+            PluginOption(name="predicates"),
+            PluginOption(name="nodeorder")])]
+        ssn = open_session(cache, tiers,
+                           [Configuration("allocate", {"mode": "solver"})])
+        get_action("allocate").execute(ssn)
+        alloc = {}
+        for key, node in cache.binder.binds.items():
+            pod_name = key.split("/")[1]
+            pg = pod_name.rsplit("-p", 1)[0]
+            cpu, mem = (1000, 1e9) if pg == "pg1" else \
+                       ((1000, 0) if pg == "pg21" else (0, 1e9))
+            c, m = alloc.get(pg, (0, 0))
+            alloc[pg] = (c + cpu, m + mem)
+        close_session(ssn)
+        # sci (weight 50 at level 1) takes half; eng's two children split
+        # the other half along their dominant resources
+        assert alloc["pg1"] == (5000, 5e9), alloc
+        assert alloc["pg21"][0] == 5000, alloc
+        assert alloc["pg22"][1] == 5e9, alloc

@@ -169,13 +169,25 @@ class AllocateAction(Action):
         taskkey = _task_order_key(ssn)
         job_order = []
         tasks_in_order = []
+        # host-only jobs (GPU sharing, required pod affinity, PVCs) that
+        # OUTRANK every device-path job run through the host loop BEFORE
+        # the solve, so per-job routing cannot invert priority (a
+        # top-priority GPU gang must not find its CPU eaten by
+        # lower-priority solver placements). Host-only jobs ranked mid
+        # -sequence still run after — an accepted coarsening of the
+        # reference's fully sequential order, noted in the contract.
+        pre_host, post_host = [], []
         for job in self._ordered_jobs(ssn):
             if job.uid in host_only:
-                continue  # routed through the host loop after the solve
+                (post_host if job_order else pre_host).append(job.uid)
+                continue
             tasks = self._pending_tasks(ssn, job, taskkey)
             if tasks:
                 job_order.append((job, tasks))
                 tasks_in_order.extend(tasks)
+        ssn.solver_options["_post_host_jobs"] = post_host
+        if pre_host:
+            self._execute_host(ssn, only_jobs=set(pre_host))
         if not tasks_in_order:
             return
 
@@ -189,6 +201,8 @@ class AllocateAction(Action):
         # the in-kernel water-fill + per-round deserved caps
         queue_opts = ssn.solver_options.get("queue_opts")
         use_queue_cap = bool(queue_opts)
+        work_conserving = bool(
+            ssn.solver_options.get("work_conserving", True))
         if use_queue_cap:
             self._fill_queue_arrays(arr, queue_opts, ssn)
 
@@ -209,6 +223,7 @@ class AllocateAction(Action):
                     p not in ("gang", "drf")
                     for p in providers[:providers.index("drf")]):
                 use_drf_order = False
+        use_hdrf_order = False
         if use_drf_order:
             attrs = drf_opts["job_attrs"]
             for j, job in enumerate(arr.jobs_list):
@@ -217,6 +232,11 @@ class AllocateAction(Action):
                     arr.job_drf_allocated[j] = \
                         attr.allocated.to_vector(arr.vocab)
             arr.drf_total = drf_opts["total"].to_vector(arr.vocab)
+            if drf_opts.get("hierarchy"):
+                from ..ops.hdrf import build_hdrf
+                build_hdrf(arr, ssn.queues, attrs,
+                           drf_opts["total_allocated"])
+                use_hdrf_order = True
 
         timing["flatten_ms"] = (_time.perf_counter() - t0) * 1e3
         t0 = _time.perf_counter()
@@ -240,7 +260,9 @@ class AllocateAction(Action):
             assigned, kind, _info = sidecar.solve(
                 fbuf, ibuf, layout, params, herd_mode=herd,
                 score_families=families, use_queue_cap=use_queue_cap,
-                use_drf_order=use_drf_order)
+                use_drf_order=use_drf_order,
+                use_hdrf_order=use_hdrf_order,
+                work_conserving=work_conserving)
             res = None
         elif dc is not None:
             # device-resident buffers, fused dispatch: the dirty-chunk
@@ -254,7 +276,9 @@ class AllocateAction(Action):
                     f2d, i2d, fi, fv, ii, iv, layout, params,
                     herd_mode=herd, score_families=families,
                     use_queue_cap=use_queue_cap,
-                    use_drf_order=use_drf_order)
+                    use_drf_order=use_drf_order,
+                    use_hdrf_order=use_hdrf_order,
+                    work_conserving=work_conserving)
             except Exception:
                 # donation may have consumed the buffers: drop the mirror
                 # so the next session re-ships in full
@@ -265,7 +289,9 @@ class AllocateAction(Action):
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
                 score_families=families, use_queue_cap=use_queue_cap,
-                use_drf_order=use_drf_order)
+                use_drf_order=use_drf_order,
+                use_hdrf_order=use_hdrf_order,
+                work_conserving=work_conserving)
         if res is not None:
             # one int16 readback instead of two int32 ones: the tunnel to a
             # remote chip is bandwidth-poor, so the result wire format
@@ -478,6 +504,11 @@ class AllocateAction(Action):
         self._execute_solver(ssn, sequential=(mode == "sequential"))
         host_only = ssn.solver_options.get("host_only_jobs")
         if host_only:
-            # jobs with required inter-pod affinity place via the host loop
-            # against the post-solve session state
-            self._execute_host(ssn, only_jobs=host_only)
+            # host-only jobs ranked after some device-path job place via
+            # the host loop against the post-solve session state (required
+            # pod affinity wants other placements visible); the outranking
+            # ones already placed BEFORE the solve in _execute_solver
+            post = ssn.solver_options.get("_post_host_jobs")
+            only = set(post) if post is not None else set(host_only)
+            if only:
+                self._execute_host(ssn, only_jobs=only)

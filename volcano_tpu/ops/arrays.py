@@ -175,6 +175,15 @@ class SnapshotArrays:
     # plugin's session-open attrs; zeros when drf is inactive)
     job_drf_allocated: np.ndarray = None  # [J,R]
     drf_total: np.ndarray = None          # [R]
+    # hierarchical-DRF tree (ops.hdrf.build_hdrf; None unless hdrf active)
+    hdrf_parent: np.ndarray = None        # [H]
+    hdrf_weight: np.ndarray = None        # [H]
+    hdrf_depth: np.ndarray = None         # [H]
+    hdrf_is_leaf: np.ndarray = None       # [H] bool
+    hdrf_leaf_req: np.ndarray = None      # [H,R]
+    hdrf_job_leaf: np.ndarray = None      # [J]
+    hdrf_ancestors: np.ndarray = None     # [J,D]
+    hdrf_total_allocated: np.ndarray = None  # [R]
     # -- nodes ---------------------------------------------------------------
     nodes_list: List[NodeInfo] = field(default_factory=list)
     node_idle: np.ndarray = None        # [N,R]
@@ -250,7 +259,25 @@ class SnapshotArrays:
                 job.total_request.to_vector(self.vocab)
 
     def device_dict(self) -> Dict[str, np.ndarray]:
-        """The arrays the solver kernel consumes (one host->device hop)."""
+        """The arrays the solver kernel consumes (one host->device hop).
+        hdrf arrays ride along only when the hierarchy was built (their
+        presence changes the packed layout, i.e. compiles an hdrf
+        variant)."""
+        d = self._base_device_dict()
+        if self.hdrf_parent is not None:
+            d.update({
+                "hdrf_parent": self.hdrf_parent,
+                "hdrf_weight": self.hdrf_weight,
+                "hdrf_depth": self.hdrf_depth,
+                "hdrf_is_leaf": self.hdrf_is_leaf,
+                "hdrf_leaf_req": self.hdrf_leaf_req,
+                "hdrf_job_leaf": self.hdrf_job_leaf,
+                "hdrf_ancestors": self.hdrf_ancestors,
+                "hdrf_total_allocated": self.hdrf_total_allocated,
+            })
+        return d
+
+    def _base_device_dict(self) -> Dict[str, np.ndarray]:
         return {
             "task_init_req": self.task_init_req,
             "task_req": self.task_req,

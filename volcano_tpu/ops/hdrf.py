@@ -1,0 +1,201 @@
+"""Hierarchical DRF ordering in the kernel.
+
+The reference's hdrf mode (plugins/drf/drf.go:527-633) keeps a queue-path
+tree whose nodes carry weighted, saturation-scaled shares, re-sorted after
+every placement. Here the tree is flattened to parent-pointer arrays once
+per session (host side) and the share recursion runs as per-depth segment
+reductions on device, so the round solver can re-rank jobs by the
+hierarchical comparator every round — the hdrf analog of the plain
+dominant-share re-rank in ops.solver.drf_state.
+
+Contract notes:
+- the comparator walk (drf.go _compareQueues) compares (saturated,
+  share/weight) level by level down the two queues' paths; the kernel
+  encodes that as a fixed-depth lexicographic key, exact for
+  uniform-depth hierarchies ("root/a/b" everywhere). Paths shorter than
+  the deepest are padded with neutral (unsaturated, share 0) levels,
+  which sorts them first where the host comparator would stop at the
+  common depth — an accepted deviation for ragged hierarchies.
+- saturation (_resource_saturated, drf.go:93-109): a leaf saturates when
+  some dimension's allocation covers its request, or it requests a
+  dimension the cluster has exhausted (not "demanding").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .arrays import bucket
+
+
+def build_hdrf(arr, queues, job_attrs, total_allocated) -> None:
+    """Fill arr.hdrf_* from the jobs' queue hierarchy annotations.
+
+    queues: ssn.queues (QueueInfo with .hierarchy "root/eng/dev" and
+    .weights "100/50/50"); job_attrs: drf plugin job attrs (unused beyond
+    presence — leaf allocations ride arr.job_drf_allocated);
+    total_allocated: cluster-wide allocated Resource (drf plugin's
+    total_allocated) for the demanding-dimension flags.
+    """
+    vocab = arr.vocab
+    R = arr.R
+    J = arr.job_min.shape[0]
+
+    # tree build: internal nodes keyed by path prefix, one leaf per job
+    index: Dict[Tuple[str, ...], int] = {("root",): 0}
+    parent = [0]        # root's parent is itself (never read)
+    weight = [1.0]
+    depth = [0]
+    max_depth = 1
+    job_path_nodes = []  # per job: list of internal node ids, depth 1..
+    for j, job in enumerate(arr.jobs_list):
+        q = queues.get(job.queue)
+        hierarchy = getattr(q, "hierarchy", "") or "root"
+        weights_s = getattr(q, "weights", "") or ""
+        paths = hierarchy.split("/")
+        wparts = weights_s.split("/")
+        node_ids = []
+        prefix = ("root",)
+        for i in range(1, len(paths)):
+            prefix = prefix + (paths[i],)
+            nid = index.get(prefix)
+            if nid is None:
+                try:
+                    w = float(wparts[i])
+                except (IndexError, ValueError):
+                    w = 1.0
+                nid = len(parent)
+                index[prefix] = nid
+                parent.append(index[prefix[:-1]])
+                weight.append(max(w, 1.0))
+                depth.append(i)
+            node_ids.append(nid)
+        job_path_nodes.append(node_ids)
+        # levels used by this job: internal 1..len(paths)-1 + leaf at
+        # index len(paths)-1 => len(paths) columns suffice
+        max_depth = max(max_depth, len(paths))
+
+    n_internal = len(parent)
+    # leaves: one per job slot (padded jobs get an inert leaf under root)
+    H = bucket(n_internal + J)
+    h_parent = np.zeros(H, np.int32)
+    h_weight = np.ones(H, np.float32)
+    h_depth = np.zeros(H, np.int32)
+    h_is_leaf = np.zeros(H, bool)
+    h_parent[:n_internal] = parent
+    h_weight[:n_internal] = weight
+    h_depth[:n_internal] = depth
+    leaf_req = np.zeros((H, R), np.float32)
+    job_leaf = np.zeros(J, np.int32)
+    D = max_depth  # deepest level that can hold a node (leaves included)
+    ancestors = np.full((J, D), -1, np.int32)
+    for j in range(J):
+        leaf = n_internal + j
+        job_leaf[j] = leaf
+        h_is_leaf[leaf] = True
+        nodes = job_path_nodes[j] if j < len(job_path_nodes) else []
+        h_parent[leaf] = nodes[-1] if nodes else 0
+        h_depth[leaf] = len(nodes) + 1
+        if j < len(arr.jobs_list):
+            leaf_req[leaf] = arr.jobs_list[j].total_request.to_vector(vocab)
+        for lvl, nid in enumerate(nodes):
+            ancestors[j, lvl] = nid
+        ancestors[j, len(nodes)] = leaf
+    # unused leaf rows for padded job slots stay inert: depth 1 under
+    # root, zero request, zero allocation -> share 0, never saturated
+    arr.hdrf_parent = h_parent
+    arr.hdrf_weight = h_weight
+    arr.hdrf_depth = h_depth
+    arr.hdrf_is_leaf = h_is_leaf
+    arr.hdrf_leaf_req = leaf_req
+    arr.hdrf_job_leaf = job_leaf
+    arr.hdrf_ancestors = ancestors
+    arr.hdrf_total_allocated = np.asarray(
+        total_allocated.to_vector(vocab), np.float32)
+
+
+def hdrf_rank_state(a, rank):
+    """Device-side: returns hdrf_rank(jobres) -> [T] int32 dense ranks.
+
+    jobres [J,R] is the solve's own placements; leaf allocations are
+    a["job_drf_allocated"] + jobres. Shares recompute bottom-up by depth
+    level (children of depth-d nodes are exactly depth d+1), then jobs
+    sort by the per-level (saturated, share/weight) lexicographic key.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T = a["task_rank"].shape[0]
+    J = a["job_min"].shape[0]
+    H = a["hdrf_parent"].shape[0]
+    D = a["hdrf_ancestors"].shape[1]
+    parent = a["hdrf_parent"]
+    weight = jnp.maximum(a["hdrf_weight"], 1.0)
+    depth = a["hdrf_depth"]
+    is_leaf = a["hdrf_is_leaf"]
+    leaf_req = a["hdrf_leaf_req"]
+    job_leaf = a["hdrf_job_leaf"]
+    ancestors = a["hdrf_ancestors"]
+    total = a["drf_total"]
+    rank = a["task_rank"] if rank is None else rank
+    first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(rank)
+    within_rank = rank - first_rank[a["task_job"]]
+
+    def share_of(alloc):  # [H,R] -> [H]
+        s = jnp.where(total[None, :] > 0.0,
+                      alloc / jnp.maximum(total[None, :], 1e-9),
+                      jnp.where(alloc > 0.0, 1.0, 0.0))
+        return jnp.max(s, axis=1)
+
+    def hdrf_rank(jobres):
+        alloc = jnp.zeros((H, a["drf_total"].shape[0]), jnp.float32)
+        alloc = alloc.at[job_leaf].add(a["job_drf_allocated"] + jobres)
+        total_alloc = a["hdrf_total_allocated"] + jnp.sum(jobres, axis=0)
+        demanding = total_alloc < total                       # [R]
+
+        share = jnp.where(is_leaf, share_of(alloc), 0.0)
+        sat_dim = (((alloc != 0.0) & (leaf_req != 0.0)
+                    & (alloc >= leaf_req))
+                   | (~demanding[None, :] & (leaf_req != 0.0)))
+        sat = is_leaf & jnp.any(sat_dim, axis=1)
+
+        for d in range(D - 1, -1, -1):  # static unroll, small depth
+            child = depth == (d + 1)
+            live = child & (share > 0.0) & ~sat
+            mdr = jax.ops.segment_min(
+                jnp.where(live, share, jnp.inf), parent, num_segments=H)
+            scale = jnp.where(
+                sat, 1.0, mdr[parent] / jnp.maximum(share, 1e-12))
+            contrib = jnp.where((child & (share > 0.0))[:, None],
+                                alloc * scale[:, None], 0.0)
+            alloc_p = jax.ops.segment_sum(contrib, parent, num_segments=H)
+            sat_p = jax.ops.segment_min(
+                jnp.where(child, sat.astype(jnp.int32), 1), parent,
+                num_segments=H) > 0
+            has_child = jax.ops.segment_max(
+                child.astype(jnp.int32), parent, num_segments=H) > 0
+            tgt = (depth == d) & ~is_leaf & has_child
+            alloc = jnp.where(tgt[:, None], alloc_p, alloc)
+            share = jnp.where(tgt, share_of(alloc_p), share)
+            sat = jnp.where(tgt, sat_p, sat)
+
+        # per-level lexicographic job key: level 1 is most significant;
+        # within a level saturation dominates share/weight
+        # (drf.go _compareQueues)
+        keys = [jnp.arange(J, dtype=jnp.int32)]  # final tie: static order
+        for lvl in range(D - 1, -1, -1):
+            anc = ancestors[:, lvl]                           # [J]
+            ok = anc >= 0
+            anc_c = jnp.maximum(anc, 0)
+            keys.append(jnp.where(ok, share[anc_c] / weight[anc_c], 0.0))
+            keys.append(jnp.where(ok, sat[anc_c], False))
+        order_j = jnp.lexsort(tuple(keys))
+        job_pos = jnp.zeros(J, jnp.int32).at[order_j].set(
+            jnp.arange(J, dtype=jnp.int32))
+        order_t = jnp.lexsort((within_rank, job_pos[a["task_job"]]))
+        return jnp.zeros(T, jnp.int32).at[order_t].set(
+            jnp.arange(T, dtype=jnp.int32))
+
+    return hdrf_rank

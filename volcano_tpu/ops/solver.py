@@ -247,7 +247,7 @@ def drf_state(a, rank):
     return a["job_drf_allocated"], drf_rank, drf_cap
 
 
-def queue_cap_state(a, rank, thr, total):
+def queue_cap_state(a, rank, thr, total, ease_unrequested: bool = True):
     """Shared prelude for in-kernel queue fair share (used by the
     single-device and mesh-sharded solvers — only the cluster `total`
     source differs): water-filled deserved, the task->queue map, and the
@@ -256,14 +256,16 @@ def queue_cap_state(a, rank, thr, total):
     deserved = water_fill_deserved(
         total, a["queue_weight"], a["queue_capability"],
         a["queue_request"], thr, max_iters=q + 1)
-    # dims a queue never requested must not bind its cap: a queue whose
-    # workloads don't use a dim should not be throttled at its
-    # (meaningless) water-filled deserved there, so those dims are
-    # replaced by +inf for the per-round caps. (This is one of two
-    # deliberate strandings-avoidance improvements over the reference's
-    # any-dim overused rule; see phase_rounds' overflow pass.)
-    deserved = jnp.where(a["queue_request"] > thr[None, :],
-                         deserved, jnp.inf)
+    if ease_unrequested:
+        # dims a queue never requested must not bind its cap: a queue
+        # whose workloads don't use a dim should not be throttled at its
+        # (meaningless) water-filled deserved there, so those dims are
+        # replaced by +inf for the per-round caps. (One of two deliberate
+        # strandings-avoidance improvements over the reference's any-dim
+        # overused rule; see phase_rounds' overflow pass. Disabled by
+        # work_conserving=False for strict reference parity.)
+        deserved = jnp.where(a["queue_request"] > thr[None, :],
+                             deserved, jnp.inf)
     task_queue = a["job_queue"][a["task_job"]]
     t = task_queue.shape[0]
     q_perm = jnp.argsort(task_queue * (t + 1) + rank)
@@ -438,7 +440,9 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
                                              "per_node_cap", "herd_mode",
                                              "score_families",
                                              "use_queue_cap",
-                                             "use_drf_order"))
+                                             "use_drf_order",
+                                             "use_hdrf_order",
+                                             "work_conserving"))
 def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    score_params: Dict[str, jnp.ndarray],
                    max_rounds: int = 64,
@@ -447,7 +451,9 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    herd_mode: str = "pack",
                    score_families: Tuple[str, ...] = ("binpack", "kube"),
                    use_queue_cap: bool = False,
-                   use_drf_order: bool = False) -> SolveResult:
+                   use_drf_order: bool = False,
+                   use_hdrf_order: bool = False,
+                   work_conserving: bool = True) -> SolveResult:
     """Round-based allocate+pipeline solve with in-kernel gang semantics.
 
     With ``use_queue_cap`` (proportion plugin active) per-queue deserved is
@@ -478,7 +484,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             a["node_alloc"] * a["node_valid"][:, None].astype(jnp.float32),
             axis=0)
         Q, deserved, task_queue, q_perm, q_seg_start = queue_cap_state(
-            a, rank, thr, total)
+            a, rank, thr, total, ease_unrequested=work_conserving)
         qalloc0 = a["queue_allocated"]
     else:
         task_queue = None
@@ -488,6 +494,12 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
 
     if use_drf_order:
         jobres0, drf_rank, drf_cap = drf_state(a, rank)
+        if use_hdrf_order:
+            # hierarchical comparator replaces the plain dominant-share
+            # ranking; the progressive-filling cap (drf_cap) still works
+            # on leaf (job) shares
+            from .hdrf import hdrf_rank_state
+            drf_rank = hdrf_rank_state(a, rank)
     else:
         jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
         drf_rank = drf_cap = None
@@ -591,7 +603,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
               excluded | barred, rounds)
         st = phase_rounds(st, use_future=False)
         st = phase_rounds(st, use_future=True)
-        if use_queue_cap:
+        if use_queue_cap and work_conserving:
             # work-conserving overflow: leftovers no competing queue could
             # take under its cap go to whoever still wants them
             st = phase_rounds(st, use_future=False, capped=False)
@@ -680,16 +692,24 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("score_families",
-                                             "use_queue_cap"))
+                                             "use_queue_cap",
+                                             "overflow_pass"))
 def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
                               score_params: Dict[str, jnp.ndarray],
                               score_families: Tuple[str, ...] = ("binpack", "kube"),
-                              use_queue_cap: bool = False) -> SolveResult:
+                              use_queue_cap: bool = False,
+                              overflow_pass: bool = False) -> SolveResult:
     """lax.scan over tasks in rank order: task k's allocation is visible to
     task k+1 and job-boundary gang revert mirrors Statement.Discard.
 
     Requires tasks grouped by job in rank order (flatten_snapshot guarantees
     this). O(T) sequential steps — use for parity tests and small problems.
+
+    overflow_pass (with use_queue_cap): after the strict deserved-capped
+    scan, run a SECOND scan over the leftover tasks with the caps relaxed
+    to hard capability — the sequential oracle for the round solver's
+    work-conserving overflow phases (capacity no competing queue could
+    take under its cap goes to whoever still wants it).
     """
     a = arrays
     T = a["task_init_req"].shape[0]
@@ -713,107 +733,134 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
     def fits_one(req, avail):
         return le_fits(req[None, :], avail, thr, scalar_mask)
 
-    def finalize_job(carry, jidx):
-        """Gang-check job jidx; revert its allocations if unready (pipelined
-        tasks survive discard, mirroring ssn.Pipeline being outside the
-        Statement in allocate.go)."""
-        (idle, pipe, npods, qalloc, assigned, kind, jalloc,
-         snap_idle, snap_pipe, snap_npods) = carry
-        ready = (a["job_ready_base"][jidx] + jalloc) >= a["job_min"][jidx]
-        is_job = (a["task_job"] == jidx)
-        revert = is_job & (assigned >= 0) & (kind == 0) & ~ready
-        idle = jnp.where(ready, idle, snap_idle)
-        npods = jnp.where(ready, npods, snap_npods)
-        if use_queue_cap:
-            # pipelined tasks survive discard, so credit back only the
-            # reverted allocations (not a snapshot restore)
-            amt = jnp.sum(a["task_req"] * revert[:, None], axis=0)
-            jq = a["job_queue"][jidx]
-            qalloc = qalloc - (jnp.arange(Q) == jq)[:, None] * amt[None, :]
-        assigned = jnp.where(revert, -1, assigned)
-        kind = jnp.where(revert, -1, kind)
-        return (idle, pipe, npods, qalloc, assigned, kind)
+    def make_pass(bound, base_alloc):
+        """One sequential scan over the tasks. bound: per-queue cap table
+        (deserved for the strict pass, hard capability for the overflow
+        pass); base_alloc [J]: allocations a prior pass already committed
+        — ready checks include them, reverts never touch them."""
 
-    def step(carry, i):
+        def finalize_job(carry, jidx):
+            (idle, pipe, npods, qalloc, assigned, kind, jalloc,
+             snap_idle, snap_pipe, snap_npods, snap_assigned) = carry
+            ready = (a["job_ready_base"][jidx] + base_alloc[jidx]
+                     + jalloc) >= a["job_min"][jidx]
+            is_job = (a["task_job"] == jidx)
+            # only THIS pass's allocations revert (a prior pass's are
+            # already dispatched): exactly the entries assigned since the
+            # job-boundary snapshot. Pipelined tasks survive discard,
+            # mirroring ssn.Pipeline being outside the Statement.
+            revert = (is_job & (assigned >= 0) & (kind == 0) & ~ready
+                      & (snap_assigned < 0))
+            idle = jnp.where(ready, idle, snap_idle)
+            npods = jnp.where(ready, npods, snap_npods)
+            if use_queue_cap:
+                amt = jnp.sum(a["task_req"] * revert[:, None], axis=0)
+                jq = a["job_queue"][jidx]
+                qalloc = qalloc - (jnp.arange(Q) == jq)[:, None] \
+                    * amt[None, :]
+            assigned = jnp.where(revert, -1, assigned)
+            kind = jnp.where(revert, -1, kind)
+            return (idle, pipe, npods, qalloc, assigned, kind)
+
+        def step(carry, i):
+            (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
+             snap_idle, snap_pipe, snap_npods, snap_assigned) = carry
+            jidx = a["task_job"][i]
+            boundary = (jidx != cur_job)
+
+            def at_boundary(args):
+                (idle, pipe, npods, qalloc, assigned, kind, jalloc,
+                 snap_idle, snap_pipe, snap_npods, snap_assigned) = args
+                idle, pipe, npods, qalloc, assigned, kind = \
+                    finalize_job(args, cur_job)
+                return (idle, pipe, npods, qalloc, assigned, kind,
+                        jnp.int32(0), idle, pipe, npods, assigned)
+
+            (idle, pipe, npods, qalloc, assigned, kind, jalloc,
+             snap_idle, snap_pipe, snap_npods, snap_assigned) = jax.lax.cond(
+                boundary, at_boundary, lambda args: args,
+                (idle, pipe, npods, qalloc, assigned, kind, jalloc,
+                 snap_idle, snap_pipe, snap_npods, snap_assigned))
+            cur_job = jidx
+
+            # the overflow pass only visits leftovers
+            valid = a["task_valid"][i] & (assigned[i] < 0)
+            req_fit = a["task_init_req"][i]
+            req_acct = a["task_req"][i]
+            sig_feas = sig_feas_all[i]
+            pods_ok = npods < a["node_max_pods"]
+            if use_queue_cap:
+                jq = a["job_queue"][jidx]
+                valid = valid & le_fits(qalloc[jq] + req_acct, bound[jq],
+                                        thr, scalar_mask,
+                                        ignore_req=req_acct)
+
+            feas_idle = fits_one(req_fit, idle) & sig_feas & pods_ok & valid
+            future = idle + a["node_extra_future"] - pipe
+            feas_fut = fits_one(req_fit, future) & sig_feas & pods_ok & valid
+
+            used_now = a["node_used"] + (a["node_idle"] - idle)
+            score = score_matrix(req_fit[None, :], idle, used_now,
+                                 a["node_alloc"], score_params,
+                                 score_families)[0]
+
+            pick_idle = jnp.any(feas_idle)
+            pick_fut = ~pick_idle & jnp.any(feas_fut)
+            feas = jnp.where(pick_idle, feas_idle, feas_fut)
+            node = jnp.argmax(jnp.where(feas, score, NEG)).astype(jnp.int32)
+            got = pick_idle | pick_fut
+            node = jnp.where(got, node, -1)
+
+            debit = jnp.where(got, req_acct, 0.0)
+            onehot = (jnp.arange(N) == node)[:, None]
+            idle = idle - jnp.where(pick_idle, debit[None, :] * onehot, 0.0)
+            pipe = pipe + jnp.where(pick_fut, debit[None, :] * onehot, 0.0)
+            npods = npods + jnp.where(pick_idle,
+                                      onehot[:, 0].astype(jnp.int32), 0)
+            if use_queue_cap:
+                q_onehot = (jnp.arange(Q) == a["job_queue"][jidx])[:, None]
+                qalloc = qalloc + q_onehot * debit[None, :]
+            # never clobber a prior pass's assignment
+            prev_a, prev_k = assigned[i], kind[i]
+            assigned = assigned.at[i].set(
+                jnp.where(prev_a >= 0, prev_a, node))
+            kind = kind.at[i].set(jnp.where(
+                prev_a >= 0, prev_k,
+                jnp.where(pick_idle, 0, jnp.where(pick_fut, 1, -1))))
+            jalloc = jalloc + jnp.where(
+                pick_idle & a["task_counts_ready"][i], 1, 0)
+            return (idle, pipe, npods, qalloc, assigned, kind, cur_job,
+                    jalloc, snap_idle, snap_pipe, snap_npods,
+                    snap_assigned), None
+
+        return finalize_job, step
+
+    def run_pass(bound, base_alloc, state):
+        idle, pipe, npods, qalloc, assigned, kind = state
+        finalize_job, step = make_pass(bound, base_alloc)
+        init = (idle, pipe, npods, qalloc, assigned, kind,
+                a["task_job"][0], jnp.int32(0),
+                idle, pipe, npods, assigned)
+        carry, _ = jax.lax.scan(step, init, jnp.arange(T))
         (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
-         snap_idle, snap_pipe, snap_npods) = carry
-        jidx = a["task_job"][i]
-        boundary = (jidx != cur_job)
-
-        def at_boundary(args):
+         snap_idle, snap_pipe, snap_npods, snap_assigned) = carry
+        return finalize_job(
             (idle, pipe, npods, qalloc, assigned, kind, jalloc,
-             snap_idle, snap_pipe, snap_npods) = args
-            idle, pipe, npods, qalloc, assigned, kind = \
-                finalize_job(args, cur_job)
-            return (idle, pipe, npods, qalloc, assigned, kind, jnp.int32(0),
-                    idle, pipe, npods)
-
-        (idle, pipe, npods, qalloc, assigned, kind, jalloc,
-         snap_idle, snap_pipe, snap_npods) = jax.lax.cond(
-            boundary, at_boundary, lambda args: args,
-            (idle, pipe, npods, qalloc, assigned, kind, jalloc,
-             snap_idle, snap_pipe, snap_npods))
-        cur_job = jidx
-
-        valid = a["task_valid"][i]
-        req_fit = a["task_init_req"][i]
-        req_acct = a["task_req"][i]
-        sig_feas = sig_feas_all[i]
-        pods_ok = npods < a["node_max_pods"]
-        if use_queue_cap:
-            # NOTE: strict per-dim caps, no work-conserving overflow pass
-            # (unlike solve_allocate): this kernel is the conservative
-            # parity oracle; heterogeneous-profile leftovers go unplaced
-            # here and are retried next session
-            jq = a["job_queue"][jidx]
-            valid = valid & le_fits(qalloc[jq] + req_acct, deserved[jq],
-                                    thr, scalar_mask, ignore_req=req_acct)
-
-        feas_idle = fits_one(req_fit, idle) & sig_feas & pods_ok & valid
-        future = idle + a["node_extra_future"] - pipe
-        feas_fut = fits_one(req_fit, future) & sig_feas & pods_ok & valid
-
-        used_now = a["node_used"] + (a["node_idle"] - idle)
-        score = score_matrix(req_fit[None, :], idle, used_now,
-                             a["node_alloc"], score_params,
-                             score_families)[0]
-
-        pick_idle = jnp.any(feas_idle)
-        pick_fut = ~pick_idle & jnp.any(feas_fut)
-        feas = jnp.where(pick_idle, feas_idle, feas_fut)
-        node = jnp.argmax(jnp.where(feas, score, NEG)).astype(jnp.int32)
-        got = pick_idle | pick_fut
-        node = jnp.where(got, node, -1)
-
-        debit = jnp.where(got, req_acct, 0.0)
-        onehot = (jnp.arange(N) == node)[:, None]
-        idle = idle - jnp.where(pick_idle, debit[None, :] * onehot, 0.0)
-        pipe = pipe + jnp.where(pick_fut, debit[None, :] * onehot, 0.0)
-        npods = npods + jnp.where(pick_idle, onehot[:, 0].astype(jnp.int32), 0)
-        if use_queue_cap:
-            q_onehot = (jnp.arange(Q) == a["job_queue"][jidx])[:, None]
-            qalloc = qalloc + q_onehot * debit[None, :]
-        assigned = assigned.at[i].set(node)
-        kind = kind.at[i].set(jnp.where(pick_idle, 0,
-                                        jnp.where(pick_fut, 1, -1)))
-        jalloc = jalloc + jnp.where(
-            pick_idle & a["task_counts_ready"][i], 1, 0)
-        return (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
-                snap_idle, snap_pipe, snap_npods), None
-
-    init = (a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"],
-            qalloc0,
-            jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32),
-            a["task_job"][0], jnp.int32(0),
-            a["node_idle"], jnp.zeros_like(a["node_idle"]), a["node_npods"])
-    carry, _ = jax.lax.scan(step, init, jnp.arange(T))
-    (idle, pipe, npods, qalloc, assigned, kind, cur_job, jalloc,
-     snap_idle, snap_pipe, snap_npods) = carry
-    idle, pipe, npods, qalloc, assigned, kind = finalize_job(
-        (idle, pipe, npods, qalloc, assigned, kind, jalloc,
-         snap_idle, snap_pipe, snap_npods), cur_job)
+             snap_idle, snap_pipe, snap_npods, snap_assigned), cur_job)
 
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
+    state = (a["node_idle"], jnp.zeros_like(a["node_idle"]),
+             a["node_npods"], qalloc0,
+             jnp.full((T,), -1, jnp.int32), jnp.full((T,), -1, jnp.int32))
+    state = run_pass(deserved, jnp.zeros(J, jnp.int32), state)
+    if overflow_pass and use_queue_cap:
+        idle, pipe, npods, qalloc, assigned, kind = state
+        base1 = jax.ops.segment_sum(
+            ((assigned >= 0) & (kind == 0)).astype(jnp.int32)
+            * counts_ready, a["task_job"], num_segments=J)
+        state = run_pass(a["queue_capability"], base1,
+                         (idle, pipe, npods, qalloc, assigned, kind))
+    idle, pipe, npods, qalloc, assigned, kind = state
     alloc_counts = jax.ops.segment_sum(
         ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
         a["task_job"], num_segments=J)
@@ -840,7 +887,8 @@ def _unpack(fbuf, ibuf, layout):
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
-    "score_families", "use_queue_cap", "use_drf_order"))
+    "score_families", "use_queue_cap", "use_drf_order", "use_hdrf_order",
+    "work_conserving"))
 def solve_allocate_packed2d(f2d, i2d, layout,
                             score_params: Dict[str, jnp.ndarray],
                             max_rounds: int = 64,
@@ -849,7 +897,9 @@ def solve_allocate_packed2d(f2d, i2d, layout,
                             herd_mode: str = "pack",
                             score_families: Tuple[str, ...] = ("binpack",),
                             use_queue_cap: bool = False,
-                            use_drf_order: bool = False) -> SolveResult:
+                            use_drf_order: bool = False,
+                            use_hdrf_order: bool = False,
+                            work_conserving: bool = True) -> SolveResult:
     """solve_allocate over the chunked device-resident buffers kept by
     ops.device_cache.PackedDeviceCache: per-session upload is only the
     dirty chunks; the flatten+slice here fuses away on device."""
@@ -862,13 +912,14 @@ def solve_allocate_packed2d(f2d, i2d, layout,
     arrays = _unpack(fbuf, ibuf, layout)
     return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
                           per_node_cap, herd_mode, score_families,
-                          use_queue_cap, use_drf_order)
+                          use_queue_cap, use_drf_order, use_hdrf_order,
+                          work_conserving)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
-    "score_families", "use_queue_cap", "use_drf_order"),
-    donate_argnums=(0, 1))
+    "score_families", "use_queue_cap", "use_drf_order", "use_hdrf_order",
+    "work_conserving"), donate_argnums=(0, 1))
 def solve_allocate_delta(f2d, i2d, f_idx, f_vals, i_idx, i_vals, layout,
                          score_params: Dict[str, jnp.ndarray],
                          max_rounds: int = 64,
@@ -877,7 +928,9 @@ def solve_allocate_delta(f2d, i2d, f_idx, f_vals, i_idx, i_vals, layout,
                          herd_mode: str = "pack",
                          score_families: Tuple[str, ...] = ("binpack",),
                          use_queue_cap: bool = False,
-                         use_drf_order: bool = False):
+                         use_drf_order: bool = False,
+                         use_hdrf_order: bool = False,
+                         work_conserving: bool = True):
     """Fused dirty-chunk scatter + solve: the whole session is ONE device
     dispatch (this call) plus ONE readback (res.compact) — on a
     latency-expensive tunnel the dispatch count IS the latency, so the
@@ -899,13 +952,15 @@ def solve_allocate_delta(f2d, i2d, f_idx, f_vals, i_idx, i_vals, layout,
     arrays = _unpack(f2d.reshape(-1)[:nf], i2d.reshape(-1)[:ni], layout)
     res = solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
                          per_node_cap, herd_mode, score_families,
-                         use_queue_cap, use_drf_order)
+                         use_queue_cap, use_drf_order, use_hdrf_order,
+                         work_conserving)
     return res, f2d, i2d
 
 
 @functools.partial(jax.jit, static_argnames=(
     "layout", "max_rounds", "max_gang_iters", "per_node_cap", "herd_mode",
-    "score_families", "use_queue_cap", "use_drf_order"))
+    "score_families", "use_queue_cap", "use_drf_order", "use_hdrf_order",
+    "work_conserving"))
 def solve_allocate_packed(fbuf, ibuf, layout,
                           score_params: Dict[str, jnp.ndarray],
                           max_rounds: int = 64,
@@ -914,10 +969,13 @@ def solve_allocate_packed(fbuf, ibuf, layout,
                           herd_mode: str = "pack",
                           score_families: Tuple[str, ...] = ("binpack",),
                           use_queue_cap: bool = False,
-                          use_drf_order: bool = False) -> SolveResult:
+                          use_drf_order: bool = False,
+                          use_hdrf_order: bool = False,
+                          work_conserving: bool = True) -> SolveResult:
     """solve_allocate over buffers produced by SnapshotArrays.packed():
     the unpack is free on device (slices fuse), the transfer is 2 puts."""
     arrays = _unpack(fbuf, ibuf, layout)
     return solve_allocate(arrays, score_params, max_rounds, max_gang_iters,
                           per_node_cap, herd_mode, score_families,
-                          use_queue_cap, use_drf_order)
+                          use_queue_cap, use_drf_order, use_hdrf_order,
+                          work_conserving)

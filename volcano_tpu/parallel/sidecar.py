@@ -113,7 +113,9 @@ class SolverServer:
             herd_mode=header["herd_mode"],
             score_families=tuple(header["score_families"]),
             use_queue_cap=header["use_queue_cap"],
-            use_drf_order=header.get("use_drf_order", False))
+            use_drf_order=header.get("use_drf_order", False),
+            use_hdrf_order=header.get("use_hdrf_order", False),
+            work_conserving=header.get("work_conserving", True))
         return {"rounds": int(np.asarray(res.rounds)),
                 "shipped_chunks": dcache.last_shipped_chunks}, \
             [np.asarray(res.assigned), np.asarray(res.kind)]
@@ -235,7 +237,9 @@ class SidecarSolver:
               herd_mode: str = "pack",
               score_families: Tuple[str, ...] = ("binpack",),
               use_queue_cap: bool = False,
-              use_drf_order: bool = False):
+              use_drf_order: bool = False,
+              use_hdrf_order: bool = False,
+              work_conserving: bool = True):
         """Returns (assigned [T] int32, kind [T] int32, info dict)."""
         names, blobs = [], [fbuf, ibuf]
         for name, val in params.items():
@@ -249,6 +253,8 @@ class SidecarSolver:
             "score_families": list(score_families),
             "use_queue_cap": bool(use_queue_cap),
             "use_drf_order": bool(use_drf_order),
+            "use_hdrf_order": bool(use_hdrf_order),
+            "work_conserving": bool(work_conserving),
         }
         out_header, out_blobs = self._request(header, blobs)
         return out_blobs[0], out_blobs[1], out_header

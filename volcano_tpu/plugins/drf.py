@@ -236,6 +236,11 @@ class DRFPlugin(Plugin):
             ssn.solver_options["drf_order"] = {
                 "job_attrs": self.job_attrs,
                 "total": self.total_resource,
+                # hdrf: the allocate action builds the queue-path tree
+                # arrays (ops.hdrf) and the kernel re-ranks by the
+                # hierarchical comparator instead of plain shares
+                "hierarchy": self._hierarchy_enabled(ssn),
+                "total_allocated": self.total_allocated,
             }
 
         namespace_order = self._namespace_order_enabled(ssn)

@@ -112,9 +112,21 @@ class PredicatesPlugin(Plugin):
         if host_only:
             ssn.solver_options["host_only_jobs"] = host_only
         if self.gpu_sharing:
-            # per-card feasibility depends on in-flight card assignments, so
-            # the allocate pass must run the sequential host loop
-            ssn.solver_options["force_host_allocate"] = True
+            # per-card feasibility depends on in-flight card assignments,
+            # which only the host loop tracks — but that's a property of
+            # GPU-REQUESTING jobs, not the cycle: route exactly those jobs
+            # through the host loop (the same per-job mechanism as
+            # affinity/PVC above) and keep everything else on the device
+            # path. One GPU job must not downgrade a 10k-pod cycle.
+            gpu_jobs = {
+                job.uid for job in ssn.jobs.values()
+                if any(gpu_resource_of_pod(t.pod) > 0
+                       for t in job.task_status_index.get(
+                           TaskStatus.PENDING, {}).values())}
+            if gpu_jobs:
+                host_only = set(ssn.solver_options.get("host_only_jobs")
+                                or ()) | gpu_jobs
+                ssn.solver_options["host_only_jobs"] = host_only
             # evict-then-discard undo must restore the card the pod actually
             # occupies, not re-run first-fit: uid -> (node_name, card id)
             released_cards = {}

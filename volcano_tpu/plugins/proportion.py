@@ -140,6 +140,16 @@ class ProportionPlugin(Plugin):
         # publish per-queue attrs so the allocate solver can water-fill
         # deserved on device and cap per-round admissions per queue
         ssn.solver_options["queue_opts"] = self.queue_opts
+        # proportion.workConserving=false pins the solver to strict
+        # reference parity: no overflow phases, no unrequested-dim cap
+        # easing (ADVICE r2 #1 — operators who need proportion.go:245's
+        # any-dim overused behavior byte-for-byte can opt out of the
+        # strandings-avoidance improvements)
+        from ..framework import Arguments
+        args = (self.arguments if isinstance(self.arguments, Arguments)
+                else Arguments(self.arguments))
+        ssn.solver_options["work_conserving"] = args.get_bool(
+            "proportion.workConserving", True)
 
         def reclaimable_fn(reclaimer, reclaimees):
             victims = []
