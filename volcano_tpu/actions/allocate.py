@@ -252,7 +252,8 @@ class AllocateAction(Action):
         if sequential:
             res = solve_allocate_sequential(
                 arr.device_dict(), params, score_families=families,
-                use_queue_cap=use_queue_cap)
+                use_queue_cap=use_queue_cap,
+                work_conserving=work_conserving)
         elif sidecar is not None:
             # process boundary: ship the packed snapshot to the solver
             # sidecar (which owns the TPU) and replay its assignments

@@ -693,12 +693,14 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
 
 @functools.partial(jax.jit, static_argnames=("score_families",
                                              "use_queue_cap",
-                                             "overflow_pass"))
+                                             "overflow_pass",
+                                             "work_conserving"))
 def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
                               score_params: Dict[str, jnp.ndarray],
                               score_families: Tuple[str, ...] = ("binpack", "kube"),
                               use_queue_cap: bool = False,
-                              overflow_pass: bool = False) -> SolveResult:
+                              overflow_pass: bool = False,
+                              work_conserving: bool = True) -> SolveResult:
     """lax.scan over tasks in rank order: task k's allocation is visible to
     task k+1 and job-boundary gang revert mirrors Statement.Discard.
 
@@ -723,8 +725,9 @@ def solve_allocate_sequential(arrays: Dict[str, jnp.ndarray],
         total = jnp.sum(
             a["node_alloc"] * a["node_valid"][:, None].astype(jnp.float32),
             axis=0)
-        Q, deserved, _, _, _ = queue_cap_state(a, a["task_rank"], thr,
-                                               total)
+        Q, deserved, _, _, _ = queue_cap_state(
+            a, a["task_rank"], thr, total,
+            ease_unrequested=work_conserving)
         qalloc0 = a["queue_allocated"]
     else:
         deserved = None

@@ -1,5 +1,6 @@
 """Device-mesh sharding of the solver (the multi-chip scale axis) and the
 solver-sidecar process boundary."""
 
+from .sharded_evict import solve_evict_uniform_sharded  # noqa: F401
 from .sharded_solver import make_mesh, solve_allocate_sharded  # noqa: F401
 from .sidecar import SidecarSolver, SolverServer  # noqa: F401

@@ -59,7 +59,7 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            score_params: Dict[str, jnp.ndarray],
                            mesh: Mesh,
                            max_rounds: int = 64,
-                           max_gang_iters: int = 8,
+                           max_gang_iters: int = 12,
                            herd_mode: str = "pack",
                            score_families: Tuple[str, ...] = ("binpack",),
                            use_queue_cap: bool = False,
@@ -166,7 +166,13 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 order = jnp.argsort(-jnp.where(has_slot, node_score, NEG))
                 pos = jnp.cumsum(eligible.astype(jnp.int32)) - 1
                 if herd_mode == "spread":
-                    m = jnp.maximum(jnp.sum(has_slot), 1)
+                    # near-best striping (ops/solver.py _waterfall_choice):
+                    # stripe only across nodes tying the best herd score
+                    masked_ns = jnp.where(has_slot, node_score, NEG)
+                    best_s = jnp.max(masked_ns)
+                    eps = 1e-5 * jnp.maximum(jnp.abs(best_s), 1.0)
+                    near = has_slot & (masked_ns >= best_s - eps)
+                    m = jnp.maximum(jnp.sum(near), 1)
                     target = order[jnp.mod(jnp.maximum(pos, 0), m)]
                 else:
                     cum = jnp.cumsum(slots[order])
@@ -272,18 +278,29 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
             out = jax.lax.while_loop(cond, body, st + (jnp.bool_(True),))
             return out[:-1]
 
+        # job order position for the gang-exclusion tie-break (replicated)
+        job_first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(
+            jnp.where(a["task_valid"], rank, T))
+
         def gang_body(s):
             (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
-             rounds, _, it, reverted_once) = s
+             rounds, _, it, revert_count, deferred, processed) = s
+            # deferred-retry queue, replicated math (see ops/solver.py
+            # gang_body): doubly-reverted jobs retry one at a time in rank
+            # order while the rest sit out
+            unproc = deferred & ~processed & ~excluded
+            cur = jnp.argmin(jnp.where(unproc, job_first_rank, BIG_KEY))
+            solo = unproc & (jnp.arange(J) == cur)
+            barred = deferred & ~solo
             st = (idle, pipe, npods, qalloc, jobres, assigned, kind,
-                  excluded, rounds)
+                  excluded | barred, rounds)
             st = phase_rounds(st, False)
             st = phase_rounds(st, True)
             if use_queue_cap:
                 # work-conserving overflow (see ops/solver.py phase_rounds)
                 st = phase_rounds(st, False, capped=False)
                 st = phase_rounds(st, True, capped=False)
-            (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
+            (idle, pipe, npods, qalloc, jobres, assigned, kind, _masked,
              rounds) = st
             alloc_counts = jax.ops.segment_sum(
                 ((assigned >= 0) & (kind == 0)).astype(jnp.int32)
@@ -293,7 +310,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
             has_alloc = jax.ops.segment_sum(
                 ((assigned >= 0) & (kind == 0)).astype(jnp.int32),
                 a["task_job"], num_segments=J) > 0
-            revert_job = ~ready & a["job_valid"] & ~excluded & has_alloc
+            revert_job = ~ready & a["job_valid"] & ~excluded & ~barred \
+                & has_alloc
             revert_task = (revert_job[a["task_job"]] & (assigned >= 0)
                            & (kind == 0))
             # credit back to this shard's nodes only
@@ -317,24 +335,30 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     num_segments=J)
             assigned = jnp.where(revert_task, -1, assigned)
             kind = jnp.where(revert_task, -1, kind)
-            # one retry per job before permanent exclusion, matching the
-            # single-device gang fixpoint (ops/solver.py gang_body)
-            excluded = excluded | (revert_job & reverted_once)
-            reverted_once = reverted_once | revert_job
+            # retry policy matches the single-device gang fixpoint
+            # (ops/solver.py gang_body): first revert retries in parallel,
+            # second defers to the solo queue, a failed solo excludes
+            revert_count = revert_count + revert_job.astype(jnp.int32)
+            excluded = excluded | (solo & revert_job)
+            processed = processed | (solo & jnp.any(unproc))
+            deferred = deferred | (revert_job & (revert_count >= 2))
+            any_more = jnp.any(revert_job) | jnp.any(
+                deferred & ~processed & ~excluded)
             return (idle, pipe, npods, qalloc, jobres, assigned, kind,
-                    excluded, rounds, jnp.any(revert_job), it + 1,
-                    reverted_once)
+                    excluded, rounds, any_more, it + 1,
+                    revert_count, deferred, processed)
 
         init = (a["node_idle"], jnp.zeros_like(a["node_idle"]),
                 a["node_npods"], qalloc0, jobres0,
                 jnp.full((T,), -1, jnp.int32),
                 jnp.full((T,), -1, jnp.int32), ~a["job_valid"],
                 jnp.int32(0), jnp.bool_(True), jnp.int32(0),
+                jnp.zeros(J, jnp.int32), jnp.zeros(J, dtype=bool),
                 jnp.zeros(J, dtype=bool))
         s = jax.lax.while_loop(
-            lambda s: s[-3] & (s[-2] < max_gang_iters), gang_body, init)
+            lambda s: s[-5] & (s[-4] < max_gang_iters), gang_body, init)
         (idle, pipe, npods, _, _, assigned, kind, excluded, rounds,
-         _, _, _) = s
+         _, _, _, _, _) = s
         alloc_counts = jax.ops.segment_sum(
             ((assigned >= 0) & (kind == 0)).astype(jnp.int32) * counts_ready,
             a["task_job"], num_segments=J)
