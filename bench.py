@@ -150,13 +150,21 @@ def headline():
 
     def one_session(jobs_s, tasks_s, grouped_s=None, drf=False):
         # fused dispatch: scatter+solve in ONE device call, then one
-        # compact readback — 2 round-trips total per session
+        # compact readback — 2 round-trips total per session (deltas over
+        # FUSED_SLOTS chunks fall back to scatter + non-fused solve)
+        from volcano_tpu.ops.solver import solve_allocate_packed2d
         arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
                                queues=queues, grouped=grouped_s)
         fill_queue_demand(arr, jobs_s, demand_cache)
         fbuf, ibuf, layout = arr.packed()
-        f2d, i2d, fi, fv, ii, iv = dcache.plan_delta(fbuf, ibuf, layout)
         params = _params(arr)
+        kind, payload = dcache.plan_delta(fbuf, ibuf, layout)
+        if kind == "updated":
+            f2d, i2d = payload
+            return solve_allocate_packed2d(f2d, i2d, layout, params,
+                                           use_queue_cap=True,
+                                           use_drf_order=drf)
+        f2d, i2d, fi, fv, ii, iv = payload
         res, nf, ni = solve_allocate_delta(
             f2d, i2d, fi, fv, ii, iv, layout, params,
             use_queue_cap=True, use_drf_order=drf)
@@ -217,6 +225,26 @@ def headline():
     device_ms = dev_dt / SESSIONS * 1e3
     device_pods_per_sec = int(len(tasks_s) * SESSIONS / dev_dt)
 
+    # DRF re-rank cost at the same scale (VERDICT r2 weak #7): identical
+    # buffers, live dominant-share ordering on device — the delta vs
+    # device_ms is the per-session price of the per-round lexsorts
+    arr.drf_total = (arr.node_alloc
+                     * arr.node_valid[:, None]).sum(axis=0).astype(
+        np.float32)
+    fbuf, ibuf, layout = arr.packed()
+    f2d, i2d = dcache.update(fbuf, ibuf, layout)
+    rd = solve_allocate_packed2d(f2d, i2d, layout, params,
+                                 use_queue_cap=True, use_drf_order=True)
+    rd.compact.block_until_ready()  # compile
+    t0 = time.perf_counter()
+    drf_futs = [solve_allocate_packed2d(f2d, i2d, layout, params,
+                                        use_queue_cap=True,
+                                        use_drf_order=True)
+                for _ in range(SESSIONS)]
+    drf_futs[-1].compact.block_until_ready()
+    drf_device_ms = (time.perf_counter() - t0) / SESSIONS * 1e3
+    drf_placed = int((np.asarray(rd.assigned)[:len(tasks_s)] >= 0).sum())
+
     # backend no-op dispatch floor (pure wire RTT on a tunneled device)
     noop = jax.jit(lambda x: x + 1)
     np.asarray(noop(np.zeros(8, np.float32)))
@@ -236,6 +264,8 @@ def headline():
         "pods_per_sec": int(placed / (p50 / 1e3)),
         "device_ms_per_session": round(device_ms, 2),
         "device_pods_per_sec": device_pods_per_sec,
+        "drf_device_ms_per_session": round(drf_device_ms, 2),
+        "drf_placed": drf_placed,
         # what a locally attached chip would see per session: host flatten
         # + device solve, no tunnel in the loop
         "p50_local_estimate_ms": round(flatten_ms + device_ms, 2),

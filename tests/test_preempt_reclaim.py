@@ -400,3 +400,48 @@ class TestEvictionMinimality:
         assert (demand <= idle0 + freed + 1e-3).all()
         # minimality: analytic minimum is 80; allow 10% slack
         assert evictions <= 88, f"evictions {evictions} vs minimum 80"
+
+
+class TestPerJobHostRouting:
+    """ADVICE r2 #3: a host-only claimer (PVC/affinity/GPU) must not
+    downgrade the whole preempt/reclaim action — other claimers keep the
+    device solver path."""
+
+    def test_preempt_keeps_solver_for_other_claimers(self, monkeypatch):
+        import volcano_tpu.actions.evict_solver as es
+        from volcano_tpu.actions.preempt import PreemptAction
+
+        calls = {}
+        orig = es.run_evict_solver
+
+        def spy(ssn, mode, skip_jobs=()):
+            calls["skip"] = set(skip_jobs)
+            return orig(ssn, mode, skip_jobs=skip_jobs)
+
+        monkeypatch.setattr(es, "run_evict_solver", spy)
+
+        high_pg = build_pod_group("high", min_member=1)
+        high_pg.spec.priority_class_name = "high-priority"
+        store, cache = make_cluster(
+            [build_node("n1", {"cpu": "2", "memory": "4Gi"})],
+            [build_pod_group("low", min_member=1), high_pg],
+            [build_pod("default", "low-0", "n1", "Running",
+                       {"cpu": "2", "memory": "2Gi"}, "low"),
+             build_pod("default", "high-0", "", "Pending",
+                       {"cpu": "2", "memory": "2Gi"}, "high")],
+            queues=[build_queue("default", 1)],
+            priority_classes=[PriorityClass("high-priority", 1000)])
+        tiers = [Tier(plugins=[PluginOption(name="priority"),
+                               PluginOption(name="gang"),
+                               PluginOption(name="conformance")]),
+                 Tier(plugins=[PluginOption(name="predicates"),
+                               PluginOption(name="nodeorder")])]
+        ssn = open_mode(cache, tiers, "solver")
+        # simulate a host-only claimer job alongside the real one
+        ssn.solver_options["host_only_jobs"] = {"default/other"}
+        PreemptAction().execute(ssn)
+        close_session(ssn)
+        # the solver ran (not a whole-cycle downgrade) and skipped exactly
+        # the host-only set
+        assert calls["skip"] == {"default/other"}
+        assert len(cache.evictor.evicts) == 1  # high evicted low via solver

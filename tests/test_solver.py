@@ -353,7 +353,9 @@ class TestFusedDelta:
             p = params_dict(arr, least_req_weight=1.0)
             ref = solve_allocate(arr.device_dict(), p)
             fbuf, ibuf, layout = arr.packed()
-            f2d, i2d, fi, fv, ii, iv = dc.plan_delta(fbuf, ibuf, layout)
+            kind2, payload = dc.plan_delta(fbuf, ibuf, layout)
+            assert kind2 == "fused", "tiny churn must fit FUSED_SLOTS"
+            f2d, i2d, fi, fv, ii, iv = payload
             res, nf, ni = solve_allocate_delta(
                 f2d, i2d, fi, fv, ii, iv, layout, p,
                 score_families=("binpack", "kube"))

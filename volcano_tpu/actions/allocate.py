@@ -268,24 +268,39 @@ class AllocateAction(Action):
         elif dc is not None:
             # device-resident buffers, fused dispatch: the dirty-chunk
             # scatter runs INSIDE the solve jit, so a session costs exactly
-            # one dispatch (scatter+solve) + one compact readback
-            from ..ops.solver import solve_allocate_delta
+            # one dispatch (scatter+solve) + one compact readback. Sessions
+            # dirtying more than FUSED_SLOTS chunks use the separate
+            # scatter + non-fused solve (3 dispatches, but no extra solve
+            # compile variants)
+            from ..ops.solver import (
+                solve_allocate_delta, solve_allocate_packed2d,
+            )
             fbuf, ibuf, layout = arr.packed()
-            f2d, i2d, fi, fv, ii, iv = dc.plan_delta(fbuf, ibuf, layout)
-            try:
-                res, new_f, new_i = solve_allocate_delta(
-                    f2d, i2d, fi, fv, ii, iv, layout, params,
-                    herd_mode=herd, score_families=families,
-                    use_queue_cap=use_queue_cap,
+            kind_, payload = dc.plan_delta(fbuf, ibuf, layout)
+            if kind_ == "updated":
+                f2d, i2d = payload
+                res = solve_allocate_packed2d(
+                    f2d, i2d, layout, params, herd_mode=herd,
+                    score_families=families, use_queue_cap=use_queue_cap,
                     use_drf_order=use_drf_order,
                     use_hdrf_order=use_hdrf_order,
                     work_conserving=work_conserving)
-            except Exception:
-                # donation may have consumed the buffers: drop the mirror
-                # so the next session re-ships in full
-                dc.reset()
-                raise
-            dc.commit(new_f, new_i)
+            else:
+                f2d, i2d, fi, fv, ii, iv = payload
+                try:
+                    res, new_f, new_i = solve_allocate_delta(
+                        f2d, i2d, fi, fv, ii, iv, layout, params,
+                        herd_mode=herd, score_families=families,
+                        use_queue_cap=use_queue_cap,
+                        use_drf_order=use_drf_order,
+                        use_hdrf_order=use_hdrf_order,
+                        work_conserving=work_conserving)
+                except Exception:
+                    # donation may have consumed the buffers: drop the
+                    # mirror so the next session re-ships in full
+                    dc.reset()
+                    raise
+                dc.commit(new_f, new_i)
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,

@@ -22,16 +22,21 @@ log = logging.getLogger(__name__)
 
 
 def collect_claimer_jobs(ssn, require_not_pipelined: bool,
-                         skip_overused: bool) -> List[Tuple[object, List]]:
+                         skip_overused: bool,
+                         skip_jobs=()) -> List[Tuple[object, List]]:
     """(job, pending_tasks) pairs in queue -> job -> task order.
 
     require_not_pipelined: preempt only feeds jobs that are not yet
     JobPipelined (preempt.go:84-90); reclaim takes any starving job.
     skip_overused: reclaim skips overused queues (reclaim.go:57-58).
+    skip_jobs: claimer uids routed through the host loop instead
+    (host-only jobs — GPU sharing / affinity / PVC).
     """
     queues_pq = PriorityQueue(ssn.queue_order_fn)
     per_queue: Dict[str, PriorityQueue] = {}
     for job in ssn.jobs.values():
+        if job.uid in skip_jobs:
+            continue
         if job.pod_group.status.phase == PodGroupPhase.PENDING:
             continue
         vr = ssn.job_valid(job)
@@ -174,7 +179,7 @@ def _uniform_job_arrays(arr, job_order):
     return job_req, job_acct, job_count
 
 
-def run_evict_solver(ssn, mode: str):
+def run_evict_solver(ssn, mode: str, skip_jobs=()):
     """Flatten claimers + victims, solve on device, replay. Returns the
     claimer jobs processed (the host loops' under_request set — preempt's
     intra-job phase must run on exactly these), or [] when there was
@@ -185,7 +190,8 @@ def run_evict_solver(ssn, mode: str):
 
     preempt = mode == "preempt"
     job_order = collect_claimer_jobs(
-        ssn, require_not_pipelined=preempt, skip_overused=not preempt)
+        ssn, require_not_pipelined=preempt, skip_overused=not preempt,
+        skip_jobs=skip_jobs)
     if not job_order:
         return []
     tasks_in_order = [t for _, tasks in job_order for t in tasks]
@@ -242,6 +248,28 @@ def run_evict_solver(ssn, mode: str):
     for j, (job, tasks) in enumerate(job_order):
         stmt = ssn.statement() if preempt else None
         evs = by_job.get(j, ())
+        if evs:
+            # post-solve validation (ADVICE r2 #2): the solve froze plugin
+            # verdicts at collection time, so several claimers can jointly
+            # evict more of one victim job than per-placement re-evaluated
+            # verdicts allow (share-bounded plugins like DRF). Re-ask the
+            # session NOW — prior jobs' evictions are already applied. If
+            # the live verdict retracts ANY planned victim, skip this
+            # claimer's whole replay (evict nothing, pipeline nothing):
+            # its placements were computed against capacity those victims
+            # would have freed, so partially replaying would pipeline onto
+            # capacity that never frees. The job retries next cycle with
+            # fresh verdicts.
+            live = [victims[vi] for vi in evs]
+            verdict = (ssn.preemptable if preempt else ssn.reclaimable)(
+                tasks[0], live)
+            allowed_now = {v.uid for v in verdict}
+            if any(victims[vi].uid not in allowed_now for vi in evs):
+                log.info("%s: live plugin verdicts retracted victims for "
+                         "%s; deferring the job to the next cycle",
+                         mode, job.uid)
+                idx += len(tasks)
+                continue
         # the job's evictions land first (cheapest-first order), then its
         # claimers pipeline — one Statement per job like the host loop's
         # per-preemptor statements rolled up. Per-victim try: one failing

@@ -71,12 +71,16 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
-        if self.resolve_mode(ssn) == "host" \
-                or ssn.solver_options.get("host_only_jobs"):
+        if self.resolve_mode(ssn) == "host":
             self._execute_host(ssn)
             return
+        # per-job routing (mirrors allocate, ADVICE r2 #3): host-only
+        # claimers run the host loop; everyone else solves on device
+        host_only = set(ssn.solver_options.get("host_only_jobs") or ())
         from .evict_solver import run_evict_solver
-        claimers = run_evict_solver(ssn, "preempt")
+        claimers = run_evict_solver(ssn, "preempt", skip_jobs=host_only)
+        if host_only:
+            self._execute_host(ssn, only_jobs=host_only)
         # intra-job task-level preemption stays on the host path (small,
         # within one job's own tasks — preempt.go:137-156 second phase).
         # It runs on exactly the solver's claimer set (the host loop's
@@ -106,13 +110,15 @@ class PreemptAction(Action):
                 if not assigned:
                     break
 
-    def _execute_host(self, ssn) -> None:
+    def _execute_host(self, ssn, only_jobs=None) -> None:
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request = []
         queues = {}
 
         for job in ssn.jobs.values():
+            if only_jobs is not None and job.uid not in only_jobs:
+                continue
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
             vr = ssn.job_valid(job)

@@ -24,14 +24,17 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
-        if self.resolve_mode(ssn) == "host" \
-                or ssn.solver_options.get("host_only_jobs"):
+        if self.resolve_mode(ssn) == "host":
             self._execute_host(ssn)
             return
+        # per-job routing (mirrors allocate, ADVICE r2 #3)
+        host_only = set(ssn.solver_options.get("host_only_jobs") or ())
         from .evict_solver import run_evict_solver
-        run_evict_solver(ssn, "reclaim")
+        run_evict_solver(ssn, "reclaim", skip_jobs=host_only)
+        if host_only:
+            self._execute_host(ssn, only_jobs=host_only)
 
-    def _execute_host(self, ssn) -> None:
+    def _execute_host(self, ssn, only_jobs=None) -> None:
         from ..plugins.predicates import PredicateError
 
         queues = PriorityQueue(ssn.queue_order_fn)
@@ -40,6 +43,8 @@ class ReclaimAction(Action):
         preemptor_tasks: Dict[str, PriorityQueue] = {}
 
         for job in ssn.jobs.values():
+            if only_jobs is not None and job.uid not in only_jobs:
+                continue
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
             vr = ssn.job_valid(job)

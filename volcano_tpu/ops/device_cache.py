@@ -139,41 +139,66 @@ class PackedDeviceCache:
     # (ops.solver.solve_allocate_delta), then commit the returned buffers
     # ------------------------------------------------------------------
 
+    #: fixed delta-slot count for the fused dispatch: the chunk-index
+    #: shape is part of the fused solve's jit signature, so EVERY distinct
+    #: size would compile another full-solve executable (~tens of seconds
+    #: each on TPU). One fixed size = exactly one fused variant; sessions
+    #: dirtying more chunks fall back to the separate-scatter path (still
+    #: zero new solve compiles — packed2d is its own single variant).
+    FUSED_SLOTS = 16
+
     def plan_delta(self, fbuf: np.ndarray, ibuf: np.ndarray, layout):
-        """Diff against the host mirror WITHOUT dispatching: returns
-        (f2d, i2d, f_idx, f_vals, i_idx, i_vals) ready for
-        solve_allocate_delta, which scatters the dirty chunks inside the
-        solve dispatch itself. The host mirror is updated eagerly; on a
-        dispatch failure the caller must call reset() so the next session
-        re-ships in full (commit() is only bookkeeping for the donated
-        buffers the solve returns).
+        """Diff against the host mirror WITHOUT dispatching the solve.
+
+        Returns (kind, payload):
+        - ("fused", (f2d, i2d, f_idx, f_vals, i_idx, i_vals)) — at most
+          FUSED_SLOTS dirty chunks: feed solve_allocate_delta, which
+          scatters inside the solve dispatch; the caller must commit()
+          the returned (donated) buffers, and on a dispatch failure call
+          reset() so the next session re-ships in full.
+        - ("updated", (f2d, i2d)) — more dirty chunks than FUSED_SLOTS:
+          the scatters were applied here (reusing the diff already
+          computed), feed the non-fused solve_allocate_packed2d.
 
         On the first call (or a layout change) the full buffers are
-        device_put and a no-op delta (chunk 0 rewritten with identical
-        bytes) is returned, so the caller has a single code path.
+        device_put and a no-op fused delta (chunk 0 rewritten with
+        identical bytes) is returned, so the caller has one code path.
         """
         c = self.chunk
         cf = -(-max(fbuf.size, 1) // c)
         ci = -(-max(ibuf.size, 1) // c)
+        k = self.FUSED_SLOTS
         if self._needs_full_ship(layout, cf, ci):
             self._full_ship(fbuf, ibuf, layout, cf, ci)
-            zero = np.zeros(1, np.int32)
-            return (self._dev_f, self._dev_i,
-                    zero, self._host_f.reshape(cf, c)[:1],
-                    zero, self._host_i.reshape(ci, c)[:1])
+            zero = np.zeros(k, np.int32)
+            return "fused", (
+                self._dev_f, self._dev_i,
+                zero, np.broadcast_to(
+                    self._host_f.reshape(cf, c)[0], (k, c)).copy(),
+                zero, np.broadcast_to(
+                    self._host_i.reshape(ci, c)[0], (k, c)).copy())
 
         f2, i2, df, di = self._diff(fbuf, ibuf, cf, ci)
-        # one shared bucket for both index arrays: a distinct (|f_idx|,
-        # |i_idx|) shape pair would compile a distinct variant of the whole
-        # fused solve, so the variant count must stay log(chunks), not
-        # log^2
-        k = _pow2_bucket(max(int(df.size), int(di.size), 1))
+        if int(df.size) > k or int(di.size) > k:
+            # too many dirty chunks for the fused variant: apply the
+            # scatters now (reusing this diff) and let the caller run the
+            # non-fused solve
+            try:
+                new_f = self._apply(self._dev_f, df, f2.reshape(cf, c))
+                new_i = self._apply(self._dev_i, di, i2.reshape(ci, c))
+            except Exception:
+                self.reset()
+                raise
+            self._dev_f, self._dev_i = new_f, new_i
+            self._host_f, self._host_i = f2, i2
+            return "updated", (self._dev_f, self._dev_i)
         f_idx = self._pad_idx(df, k)
         i_idx = self._pad_idx(di, k)
         self._host_f, self._host_i = f2, i2
-        return (self._dev_f, self._dev_i,
-                f_idx, f2.reshape(cf, c)[f_idx],
-                i_idx, i2.reshape(ci, c)[i_idx])
+        return "fused", (
+            self._dev_f, self._dev_i,
+            f_idx, f2.reshape(cf, c)[f_idx],
+            i_idx, i2.reshape(ci, c)[i_idx])
 
     @staticmethod
     def _pad_idx(idx: np.ndarray, k: int) -> np.ndarray:

@@ -201,6 +201,75 @@ def solve_evict(arrays: Dict[str, jnp.ndarray],
                                               need.shape[0]))
 
 
+def absorb_counts(r, r_fit, sig, base, ptot, has_v, feas_n, thr, sm,
+                  t_cap: float):
+    """Per-node claimer-absorption counts for one uniform job: (m_all,
+    f_n, cap_extra) where f_n = claimers fitting with NO eviction, m_all =
+    max with all eligible victims freed, cap_extra = slots costing
+    evictions. Shared by the single-device and mesh-sharded kernels —
+    floor + one-step le_fits-validated backoff, so the chosen count
+    always fits and a victim cut always exists."""
+
+    def fits_m(mm, av):
+        return le_fits(mm[:, None] * r_fit[None, :], av, thr, sm,
+                       ignore_req=r[None, :])
+
+    def validated(av):
+        per_dim = jnp.where(sig[None, :],
+                            jnp.floor(av / jnp.maximum(r, 1e-9)),
+                            jnp.inf)
+        m = jnp.min(per_dim, axis=1)
+        m = jnp.clip(jnp.nan_to_num(m, posinf=t_cap), 0.0, t_cap)
+        back = jnp.maximum(m - 1.0, 0.0)
+        return jnp.where(fits_m(m, av), m,
+                         jnp.where(fits_m(back, av), back, 0.0))
+
+    avail = base + ptot
+    m = jnp.where(feas_n & has_v, validated(avail), 0.0)
+    f_n = jnp.where(feas_n, validated(base), 0.0)
+    m_all = jnp.where(has_v, jnp.maximum(m, f_n), f_n)
+    return m_all, f_n, jnp.maximum(m_all - f_n, 0.0)
+
+
+def spread_counts(count, score_j, m_all, f_all, cap_extra):
+    """Eviction-minimal spread of `count` claimers over nodes: fill free
+    capacity first in score order, then waterfill the remainder evenly
+    across nodes (trimming the surplus from the lowest-scoring at-level
+    nodes). Returns (c [N] int32, order [N], cum [N] float32) — order/cum
+    drive the claimer-position -> node mapping. Pure [N]-vector math, so
+    the sharded kernel runs it replicated on gathered vectors."""
+    N = m_all.shape[0]
+    order = jnp.argsort(-score_j)
+    f_o = f_all[order]
+    cum_f = jnp.cumsum(f_o)
+    c_free_o = jnp.clip(count.astype(jnp.float32) - (cum_f - f_o),
+                        0.0, f_o)
+    c_free = jnp.zeros(N, jnp.float32).at[order].set(c_free_o)
+    D = jnp.maximum(count.astype(jnp.float32) - jnp.sum(c_free), 0.0)
+    # waterfill level l* = smallest l with sum(min(cap_extra, l)) >= D
+    srt = jnp.sort(cap_extra)
+    csum = jnp.cumsum(srt)
+    S = csum + srt * (N - 1 - jnp.arange(N, dtype=jnp.float32))
+    found = jnp.any(S >= D)
+    i0 = jnp.argmax(S >= D)
+    csum_prev = jnp.where(i0 > 0, csum[jnp.maximum(i0 - 1, 0)], 0.0)
+    seg = jnp.maximum((N - i0).astype(jnp.float32), 1.0)
+    lvl = jnp.ceil((D - csum_prev) / seg)
+    lvl = jnp.where(found, jnp.maximum(lvl, 0.0),
+                    jnp.max(cap_extra, initial=0.0))
+    c_extra = jnp.minimum(cap_extra, lvl)
+    surplus = jnp.maximum(jnp.sum(c_extra) - D, 0.0)
+    at_level = (c_extra >= lvl) & (lvl > 0)
+    trim_order = jnp.argsort(jnp.where(at_level, score_j, jnp.inf))
+    trim_pos = jnp.zeros(N, jnp.int32).at[trim_order].set(
+        jnp.arange(N, dtype=jnp.int32))
+    c_extra = c_extra - (at_level
+                         & (trim_pos < surplus)).astype(jnp.float32)
+    c = (c_free + c_extra).astype(jnp.int32)
+    cum = jnp.cumsum(c[order]).astype(jnp.float32)
+    return c, order, cum
+
+
 @functools.partial(jax.jit, static_argnames=(
     "score_families", "require_freed_covers", "stop_at_need"))
 def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
@@ -283,56 +352,13 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
         ptot = jax.ops.segment_sum(vreq_m, v_node, num_segments=N)  # [N,R]
         has_v = jax.ops.segment_max(
             elig_v.astype(jnp.int32), v_node, num_segments=N) > 0
-        # max claimers node n can absorb with ALL its eligible victims
-        # freed: largest m with m*r fitting future+ptot (threshold-eased)
         base = jnp.zeros_like(future) if require_freed_covers else future
-        avail = base + ptot                                        # [N,R]
-        # conservative count: start from floor(avail / r) over requested
-        # dims (no +thr easing — that could admit an m whose demand then
-        # fails the fit check), then VALIDATE the candidate with le_fits
-        # itself so every dim rule matches exactly — zero-request
-        # non-scalar dims with negative avail zero the node out, and a
-        # float-division round-up backs off one step. The chosen count
-        # therefore always fits and a victim cut always exists.
-        per_dim = jnp.where(
-            sig[None, :],
-            jnp.floor(avail / jnp.maximum(r, 1e-9)),
-            jnp.inf)
-        m = jnp.min(per_dim, axis=1)                               # [N]
-        m = jnp.clip(jnp.nan_to_num(m, posinf=float(T)), 0.0, float(T))
-
-        def fits_m(mm):
-            return le_fits(mm[:, None] * r_fit[None, :], avail, thr, sm,
-                           ignore_req=r[None, :])
-
-        m_back = jnp.maximum(m - 1.0, 0.0)
-        m = jnp.where(fits_m(m), m,
-                      jnp.where(fits_m(m_back), m_back, 0.0))
+        # per-node absorption counts (free-capacity slots included —
+        # victimless feasible nodes count: eviction minimality means
+        # spending idle capacity before killing anything)
         feas_n = job_feas[j] & a["node_valid"]
-        m = jnp.where(feas_n & has_v, m, 0.0)
-
-        # free slots (claimers a node absorbs with NO eviction): same
-        # floor + le_fits validation against the un-freed base. Victimless
-        # feasible nodes count here — eviction minimality means spending
-        # idle capacity before killing anything.
-        per_dim_f = jnp.where(
-            sig[None, :],
-            jnp.floor(base / jnp.maximum(r, 1e-9)), jnp.inf)
-        f_n = jnp.min(per_dim_f, axis=1)
-        f_n = jnp.clip(jnp.nan_to_num(f_n, posinf=float(T)), 0.0, float(T))
-
-        def fits_f(mm):
-            return le_fits(mm[:, None] * r_fit[None, :], base, thr, sm,
-                           ignore_req=r[None, :])
-
-        f_back = jnp.maximum(f_n - 1.0, 0.0)
-        f_n = jnp.where(fits_f(f_n), f_n,
-                        jnp.where(fits_f(f_back), f_back, 0.0))
-        f_n = jnp.where(feas_n, f_n, 0.0)
-        # node capacity: victims-freed max where victims exist, free slots
-        # elsewhere (m already includes the node's free capacity)
-        m_all = jnp.where(has_v, jnp.maximum(m, f_n), f_n)
-        cap_extra = jnp.maximum(m_all - f_n, 0.0)   # slots costing evictions
+        m_all, f_n, cap_extra = absorb_counts(
+            r, r_fit, sig, base, ptot, has_v, feas_n, thr, sm, float(T))
 
         total = jnp.sum(m_all).astype(jnp.int32)
         # gang: need `need[j]` pipelines; if unreachable place nothing
@@ -340,43 +366,13 @@ def solve_evict_uniform(arrays: Dict[str, jnp.ndarray],
         do = active & satisfied & (total > 0)
         count = jnp.where(do, jnp.minimum(count, total), 0)
 
-        # ---- eviction-minimal spread (preempt.go:219-240 evicts the
-        # cheapest prefix per preemptor; the batched equivalent is: fill
-        # free capacity first, then waterfill the remainder evenly so no
-        # node over-evicts while another sits on idle victims) ----
+        # eviction-minimal spread (preempt.go:219-240 evicts the cheapest
+        # prefix per preemptor; the batched equivalent fills free capacity
+        # first, then waterfills the remainder evenly so no node
+        # over-evicts while another sits on idle victims)
         score_j = jnp.where(m_all > 0, job_score[j], NEG)
-        order = jnp.argsort(-score_j)                              # [N]
-        # phase 1: free slots in score order
-        f_o = f_n[order]
-        cum_f = jnp.cumsum(f_o)
-        c_free_o = jnp.clip(count.astype(jnp.float32) - (cum_f - f_o),
-                            0.0, f_o)
-        c_free = jnp.zeros(N, jnp.float32).at[order].set(c_free_o)
-        D = jnp.maximum(count.astype(jnp.float32) - jnp.sum(c_free), 0.0)
-        # phase 2: waterfill level l* = smallest l with
-        # sum(min(cap_extra, l)) >= D, then trim the surplus from the
-        # lowest-scoring at-level nodes
-        srt = jnp.sort(cap_extra)                                  # asc
-        csum = jnp.cumsum(srt)
-        S = csum + srt * (N - 1 - jnp.arange(N, dtype=jnp.float32))
-        found = jnp.any(S >= D)
-        i0 = jnp.argmax(S >= D)
-        csum_prev = jnp.where(i0 > 0, csum[jnp.maximum(i0 - 1, 0)], 0.0)
-        seg = jnp.maximum((N - i0).astype(jnp.float32), 1.0)
-        lvl = jnp.ceil((D - csum_prev) / seg)
-        lvl = jnp.where(found, jnp.maximum(lvl, 0.0),
-                        jnp.max(cap_extra, initial=0.0))
-        c_extra = jnp.minimum(cap_extra, lvl)
-        surplus = jnp.maximum(jnp.sum(c_extra) - D, 0.0)
-        at_level = (c_extra >= lvl) & (lvl > 0)
-        trim_order = jnp.argsort(jnp.where(at_level, score_j, jnp.inf))
-        trim_pos = jnp.zeros(N, jnp.int32).at[trim_order].set(
-            jnp.arange(N, dtype=jnp.int32))
-        c_extra = c_extra - (at_level
-                             & (trim_pos < surplus)).astype(jnp.float32)
-        c = (c_free + c_extra).astype(jnp.int32)                   # [N]
-        # task->node mapping order: cumulative placements in score order
-        cum = jnp.cumsum(c[order]).astype(jnp.float32)
+        c, order, cum = spread_counts(count, score_j, m_all, f_n,
+                                      cap_extra)
 
         # task -> node: claimer position p lands on the node where the
         # score-ordered cumulative count first exceeds p

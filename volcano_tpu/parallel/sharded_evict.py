@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.evict import EvictResult
+from ..ops.evict import EvictResult, absorb_counts, spread_counts
 from ..ops.solver import NEG, _segment_prefix, le_fits, score_matrix
 from .sharded_solver import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -142,42 +142,19 @@ def _solve_sharded(arrays, victims, score_params, mesh,
                 num_segments=n_loc) > 0
             base = (jnp.zeros_like(future) if require_freed_covers
                     else future)
-            avail = base + ptot
-            per_dim = jnp.where(sig[None, :],
-                                jnp.floor(avail / jnp.maximum(r, 1e-9)),
-                                jnp.inf)
-            m = jnp.min(per_dim, axis=1)
-            m = jnp.clip(jnp.nan_to_num(m, posinf=float(T)), 0.0, float(T))
-
-            def fits_m(mm, av):
-                return le_fits(mm[:, None] * r_fit[None, :], av, thr, sm,
-                               ignore_req=r[None, :])
-
-            m = jnp.where(fits_m(m, avail), m,
-                          jnp.where(fits_m(jnp.maximum(m - 1, 0), avail),
-                                    jnp.maximum(m - 1, 0), 0.0))
+            # per-node absorption counts: SAME math as the single-device
+            # kernel (ops/evict.py absorb_counts), on this shard's nodes
             feas_n = job_feas_loc[j] & a["node_valid"]
-            m = jnp.where(feas_n & has_v, m, 0.0)
-
-            per_dim_f = jnp.where(sig[None, :],
-                                  jnp.floor(base / jnp.maximum(r, 1e-9)),
-                                  jnp.inf)
-            f_n = jnp.min(per_dim_f, axis=1)
-            f_n = jnp.clip(jnp.nan_to_num(f_n, posinf=float(T)), 0.0,
-                           float(T))
-            f_n = jnp.where(fits_m(f_n, base), f_n,
-                            jnp.where(fits_m(jnp.maximum(f_n - 1, 0), base),
-                                      jnp.maximum(f_n - 1, 0), 0.0))
-            f_n = jnp.where(feas_n, f_n, 0.0)
-            m_all_loc = jnp.where(has_v, jnp.maximum(m, f_n), f_n)
-            cap_loc = jnp.maximum(m_all_loc - f_n, 0.0)
+            m_all_loc, f_loc, cap_loc = absorb_counts(
+                r, r_fit, sig, base, ptot, has_v, feas_n, thr, sm,
+                float(T))
 
             # replicated spread over gathered [N] vectors (same math as
-            # ops/evict.py solve_evict_uniform)
+            # ops/evict.py spread_counts)
             score_all = jax.lax.all_gather(job_score_loc[j], "n",
                                            tiled=True)
             m_all = jax.lax.all_gather(m_all_loc, "n", tiled=True)
-            f_all = jax.lax.all_gather(f_n, "n", tiled=True)
+            f_all = jax.lax.all_gather(f_loc, "n", tiled=True)
             cap_extra = jax.lax.all_gather(cap_loc, "n", tiled=True)
 
             total = jnp.sum(m_all).astype(jnp.int32)
@@ -187,34 +164,8 @@ def _solve_sharded(arrays, victims, score_params, mesh,
             count = jnp.where(do, jnp.minimum(count, total), 0)
 
             score_j = jnp.where(m_all > 0, score_all, NEG)
-            order = jnp.argsort(-score_j)
-            f_o = f_all[order]
-            cum_f = jnp.cumsum(f_o)
-            c_free_o = jnp.clip(count.astype(jnp.float32) - (cum_f - f_o),
-                                0.0, f_o)
-            c_free = jnp.zeros(N, jnp.float32).at[order].set(c_free_o)
-            Dm = jnp.maximum(count.astype(jnp.float32) - jnp.sum(c_free),
-                             0.0)
-            srt = jnp.sort(cap_extra)
-            csum = jnp.cumsum(srt)
-            S = csum + srt * (N - 1 - jnp.arange(N, dtype=jnp.float32))
-            found = jnp.any(S >= Dm)
-            i0 = jnp.argmax(S >= Dm)
-            csum_prev = jnp.where(i0 > 0, csum[jnp.maximum(i0 - 1, 0)], 0.0)
-            seg = jnp.maximum((N - i0).astype(jnp.float32), 1.0)
-            lvl = jnp.ceil((Dm - csum_prev) / seg)
-            lvl = jnp.where(found, jnp.maximum(lvl, 0.0),
-                            jnp.max(cap_extra, initial=0.0))
-            c_extra = jnp.minimum(cap_extra, lvl)
-            surplus = jnp.maximum(jnp.sum(c_extra) - Dm, 0.0)
-            at_level = (c_extra >= lvl) & (lvl > 0)
-            trim_order = jnp.argsort(jnp.where(at_level, score_j, jnp.inf))
-            trim_pos = jnp.zeros(N, jnp.int32).at[trim_order].set(
-                jnp.arange(N, dtype=jnp.int32))
-            c_extra = c_extra - (at_level
-                                 & (trim_pos < surplus)).astype(jnp.float32)
-            c = (c_free + c_extra).astype(jnp.int32)            # [N] global
-            cum = jnp.cumsum(c[order]).astype(jnp.float32)
+            c, order, cum = spread_counts(count, score_j, m_all, f_all,
+                                          cap_extra)
 
             is_mine = (a["task_job"] == j) & a["task_valid"]
             p = task_pos

@@ -32,6 +32,13 @@ import numpy as np
 
 _MAGIC = b"VTS1"
 
+#: frame-size ceilings (ADVICE r2 #4): a corrupt or hostile local client
+#: must not drive unbounded allocation. 1 MiB of JSON header and 256 MiB
+#: per blob dwarf any real snapshot (10k x 2k packs to ~0.5 MB) while
+#: keeping a garbage length prefix from OOMing the solver process.
+MAX_HEADER_BYTES = 1 << 20
+MAX_BLOB_BYTES = 256 << 20
+
 
 # -- framing ----------------------------------------------------------------
 
@@ -61,10 +68,16 @@ def _recv_frame(sock: socket.socket):
     if magic != _MAGIC:
         raise ConnectionError(f"bad magic {magic!r}")
     (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if hlen > MAX_HEADER_BYTES:
+        raise ConnectionError(f"header length {hlen} exceeds cap "
+                              f"{MAX_HEADER_BYTES}")
     header = json.loads(_recv_exact(sock, hlen))
     blobs = []
     for spec in header.pop("blobs", []):
         (blen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        if blen > MAX_BLOB_BYTES:
+            raise ConnectionError(f"blob length {blen} exceeds cap "
+                                  f"{MAX_BLOB_BYTES}")
         arr = np.frombuffer(_recv_exact(sock, blen),
                             dtype=np.dtype(spec["dtype"]))
         blobs.append(arr.reshape(spec["shape"]))
@@ -153,7 +166,9 @@ class SolverServer:
             pass
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.path)
-        self._listener.listen(1)
+        # backlog > 1 so a second client connects instead of hanging in
+        # the kernel queue forever; it gets an explicit busy error below
+        self._listener.listen(4)
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
@@ -162,6 +177,32 @@ class SolverServer:
             with conn:
                 try:
                     while True:
+                        # between frames, watch the listener too: a second
+                        # client gets an explicit busy error instead of
+                        # queueing silently behind this one (one chip, one
+                        # client at a time). The served connection is
+                        # handled FIRST: when it has pending data or EOF
+                        # (e.g. a restarting scheduler whose old socket
+                        # just closed), that must resolve before any
+                        # busy-reject, or the legitimate reconnect would
+                        # be bounced while the stale client is already
+                        # gone.
+                        import select as _select
+                        ready, _, _ = _select.select(
+                            [conn, self._listener], [], [])
+                        if conn not in ready:
+                            # only the listener is ready: the served
+                            # client is verifiably alive-and-idle (a dead
+                            # one would be readable with EOF)
+                            try:
+                                conn2, _ = self._listener.accept()
+                                with conn2:
+                                    _send_frame(conn2, {
+                                        "error": "busy: another client "
+                                                 "is being served"}, [])
+                            except OSError:
+                                pass
+                            continue
                         header, blobs = _recv_frame(conn)
                         if header.get("op") == "shutdown":
                             self._stop.set()

@@ -148,12 +148,21 @@ def generate_self_signed_cert(cert_dir: Optional[str] = None,
 # -- the served boundary -----------------------------------------------------
 
 class AdmissionServer:
-    """TLS admission server over the registered AdmissionServices."""
+    """TLS admission server over the registered AdmissionServices.
+
+    Client authentication: pass ``client_ca_path`` to require mutual TLS
+    (the reference's webhook is authenticated by the API server; a bare
+    deployment of this one would otherwise accept admission traffic from
+    anyone who can reach the port — ADVICE r2 #5). The default, no client
+    verification, is for dev/loopback use only — the default bind address
+    stays 127.0.0.1 for that reason.
+    """
 
     def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0,
                  cert_path: Optional[str] = None,
                  key_path: Optional[str] = None,
-                 cert_dir: Optional[str] = None):
+                 cert_dir: Optional[str] = None,
+                 client_ca_path: Optional[str] = None):
         if cert_path is None or key_path is None:
             cert_path, key_path = generate_self_signed_cert(cert_dir)
         self.cert_path = cert_path
@@ -201,6 +210,11 @@ class AdmissionServer:
         self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(cert_path, key_path)
+        if client_ca_path is not None:
+            # mutual TLS: only clients presenting a cert signed by this
+            # CA may drive admission
+            ctx.load_verify_locations(cafile=client_ca_path)
+            ctx.verify_mode = ssl.CERT_REQUIRED
         self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
                                              server_side=True)
         self.address = self._httpd.server_address
