@@ -348,7 +348,7 @@ def full_cycle():
     # steady state: 100 new pods/cycle on the now-10k-running cluster.
     # Two warm cycles first: the steady wave's flatten buckets (T~128 vs
     # the burst's 10k) compile their own solve variant.
-    lat, placed = [], []
+    lat, host_ms, placed = [], [], []
     wave = n_jobs
     for w in range(20):
         make_wave(store, wave)
@@ -363,6 +363,11 @@ def full_cycle():
         t0 = time.perf_counter()
         sched.run_once()
         lat.append((time.perf_counter() - t0) * 1e3)
+        t = sched.last_cycle_timing
+        # host share = everything but the (RTT-dominated on a tunnel)
+        # solve dispatch+readback — what a locally attached chip's cycle
+        # would cost beyond its own few-ms device time
+        host_ms.append(t["total_ms"] - t.get("solve_ms", 0.0))
         placed.append(len(cache.binder.binds) - before)
     steady_timing = dict_timing(sched)
     p50 = float(np.percentile(lat, 50))
@@ -372,6 +377,7 @@ def full_cycle():
         "burst_decomp": burst_timing,
         "steady_p50_ms": round(p50, 2),
         "steady_p90_ms": round(float(np.percentile(lat, 90)), 2),
+        "steady_host_p50_ms": round(float(np.percentile(host_ms, 50)), 2),
         "steady_placed_per_cycle": int(np.median(placed)),
         "steady_decomp": steady_timing,
         "cycles": SESSIONS,

@@ -180,3 +180,66 @@ class TestAsyncEffectors:
         close_session(ssn)
         cache.wait_for_effects()
         assert cache.binder.binds == {"c1/p1": "n1"}
+
+
+class TestSnapshotCloneReuse:
+    """Version-gated snapshot clone reuse: unchanged objects hand back the
+    SAME clone; any cache-side or session-side mutation forces a fresh
+    one."""
+
+    def _world(self):
+        from volcano_tpu.client import ClusterStore
+
+        store = ClusterStore()
+        cache = SchedulerCache(store)
+        cache.binder = FakeBinder()
+        cache.evictor = FakeEvictor()
+        cache.run()
+        store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        store.create("podgroups", build_pod_group("j1", "ns", min_member=1))
+        store.create("pods", build_pod("ns", "j1-0", "", "Pending",
+                                       {"cpu": "1", "memory": "1Gi"}, "j1"))
+        return store, cache
+
+    def test_unchanged_objects_reuse_clones(self):
+        store, cache = self._world()
+        s1 = cache.snapshot()
+        s2 = cache.snapshot()
+        assert s2.jobs["ns/j1"] is s1.jobs["ns/j1"]
+        assert s2.nodes["n1"] is s1.nodes["n1"]
+
+    def test_cache_side_change_invalidates(self):
+        store, cache = self._world()
+        s1 = cache.snapshot()
+        pod = store.get("pods", "j1-0", "ns")
+        pod.phase = "Running"
+        pod.node_name = "n1"
+        store.update("pods", pod)  # informer flips the task
+        s2 = cache.snapshot()
+        assert s2.jobs["ns/j1"] is not s1.jobs["ns/j1"]
+        assert s2.nodes["n1"] is not s1.nodes["n1"]
+        t = s2.jobs["ns/j1"].tasks["ns/j1-0"]
+        from volcano_tpu.api import TaskStatus
+        assert t.status == TaskStatus.RUNNING
+
+    def test_session_side_mutation_invalidates(self):
+        from volcano_tpu.api import TaskStatus
+
+        store, cache = self._world()
+        s1 = cache.snapshot()
+        job = s1.jobs["ns/j1"]
+        task = next(iter(job.tasks.values()))
+        job.update_task_status(task, TaskStatus.ALLOCATED)  # session mutates
+        s2 = cache.snapshot()
+        assert s2.jobs["ns/j1"] is not job
+        t2 = next(iter(s2.jobs["ns/j1"].tasks.values()))
+        assert t2.status == TaskStatus.PENDING  # fresh from cache truth
+
+    def test_reused_clone_fit_errors_cleared(self):
+        from volcano_tpu.api.unschedule_info import FitErrors
+
+        store, cache = self._world()
+        s1 = cache.snapshot()
+        s1.jobs["ns/j1"].nodes_fit_errors["ns/j1-0"] = FitErrors()
+        s2 = cache.snapshot()
+        assert not s2.jobs["ns/j1"].nodes_fit_errors

@@ -278,6 +278,9 @@ class SchedulerCache:
         # write; the job updater's skip-if-untouched check compares against
         # this (NOT session open) so inter-session informer changes count
         self.updater_versions: Dict[str, int] = {}
+        # version-gated snapshot clone reuse (see _snapshot_locked)
+        self._job_clone_cache: Dict[str, tuple] = {}
+        self._node_clone_cache: Dict[str, tuple] = {}
 
         self._create_default_queue()
 
@@ -423,6 +426,7 @@ class SchedulerCache:
         if job is not None and not job.tasks and job.pod_group is None:
             del self.jobs[ti.job]
             self.updater_versions.pop(ti.job, None)
+            self._job_clone_cache.pop(ti.job, None)
 
     # -- node handlers ------------------------------------------------------
 
@@ -438,6 +442,7 @@ class SchedulerCache:
 
     def delete_node(self, node) -> None:
         self.nodes.pop(node.name, None)
+        self._node_clone_cache.pop(node.name, None)
 
     # -- podgroup / queue / priorityclass handlers --------------------------
 
@@ -456,6 +461,7 @@ class SchedulerCache:
         if not job.tasks:
             del self.jobs[key]
             self.updater_versions.pop(key, None)
+            self._job_clone_cache.pop(key, None)
 
     def add_queue(self, queue: Queue) -> None:
         self.queues[queue.name] = QueueInfo(queue)
@@ -532,10 +538,28 @@ class SchedulerCache:
         if drop is not None:
             drop()  # assumptions are session-scoped
         sn = ClusterInfo()
+        # Version-gated clone reuse: a clone handed to the PREVIOUS session
+        # can serve again iff (a) the cache object hasn't changed since it
+        # was cut AND (b) the session didn't mutate the clone — both
+        # observable as recorded == cache.flat_version == clone.flat_version
+        # (every mutation path bumps the version). This cuts the per-cycle
+        # clone fan-out, the scheduler's host floor, to the churned subset —
+        # the same delta idea the flatten/device caches use. Contract:
+        # sessions on one cache are SEQUENTIAL (the scheduler loop); the
+        # reference's snapshot has the same assumption (one runOnce at a
+        # time under the scheduler mutex, cache.go:693-742).
         for name, ni in self.nodes.items():
             if not ni.ready:
                 continue
-            sn.nodes[name] = ni.clone()
+            ent = self._node_clone_cache.get(name)
+            if ent is not None and ent[0] == ni.flat_version \
+                    and ent[1].flat_version == ni.flat_version \
+                    and ent[1].flat_epoch == ni.flat_epoch:
+                sn.nodes[name] = ent[1]
+                continue
+            clone = ni.clone()
+            self._node_clone_cache[name] = (ni.flat_version, clone)
+            sn.nodes[name] = clone
         for name, qi in self.queues.items():
             sn.queues[name] = qi.clone()
         for name, coll in self.namespace_collections.items():
@@ -547,7 +571,20 @@ class SchedulerCache:
             if job.queue not in self.queues:
                 log.info("job %s skipped: queue %s not found", key, job.queue)
                 continue
-            clone = job.clone()
+            ent = self._job_clone_cache.get(key)
+            if ent is not None and ent[0] == job.flat_version \
+                    and ent[1].flat_version == job.flat_version:
+                clone = ent[1]
+                # per-session slates that don't bump the version; the
+                # timestamp reset matches fresh-clone-per-cycle semantics
+                # (the cache-side job never carries it, so a fresh clone
+                # always started from None)
+                if clone.nodes_fit_errors:
+                    clone.nodes_fit_errors = {}
+                clone.schedule_start_timestamp = None
+            else:
+                clone = job.clone()
+                self._job_clone_cache[key] = (job.flat_version, clone)
             # resolve job priority from the PodGroup's priority class
             clone.priority = self.default_priority
             pc = self.priority_classes.get(clone.priority_class_name)
