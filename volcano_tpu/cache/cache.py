@@ -279,8 +279,8 @@ class SchedulerCache:
         # this (NOT session open) so inter-session informer changes count
         self.updater_versions: Dict[str, int] = {}
         # version-gated snapshot clone reuse (see _snapshot_locked)
-        self._job_clone_cache: Dict[str, tuple] = {}
-        self._node_clone_cache: Dict[str, tuple] = {}
+        self._job_clone_cache: Dict[str, JobInfo] = {}
+        self._node_clone_cache: Dict[str, NodeInfo] = {}
 
         self._create_default_queue()
 
@@ -551,14 +551,13 @@ class SchedulerCache:
         for name, ni in self.nodes.items():
             if not ni.ready:
                 continue
-            ent = self._node_clone_cache.get(name)
-            if ent is not None and ent[0] == ni.flat_version \
-                    and ent[1].flat_version == ni.flat_version \
-                    and ent[1].flat_epoch == ni.flat_epoch:
-                sn.nodes[name] = ent[1]
+            prev = self._node_clone_cache.get(name)
+            if prev is not None and prev.flat_version == ni.flat_version \
+                    and prev.flat_epoch == ni.flat_epoch:
+                sn.nodes[name] = prev
                 continue
             clone = ni.clone()
-            self._node_clone_cache[name] = (ni.flat_version, clone)
+            self._node_clone_cache[name] = clone
             sn.nodes[name] = clone
         for name, qi in self.queues.items():
             sn.queues[name] = qi.clone()
@@ -571,10 +570,12 @@ class SchedulerCache:
             if job.queue not in self.queues:
                 log.info("job %s skipped: queue %s not found", key, job.queue)
                 continue
-            ent = self._job_clone_cache.get(key)
-            if ent is not None and ent[0] == job.flat_version \
-                    and ent[1].flat_version == job.flat_version:
-                clone = ent[1]
+            prev = self._job_clone_cache.get(key)
+            # clone() copies the version and the global counter never
+            # repeats, so one comparison covers both cache-side and
+            # session-side mutation since the clone was cut
+            if prev is not None and prev.flat_version == job.flat_version:
+                clone = prev
                 # per-session slates that don't bump the version; the
                 # timestamp reset matches fresh-clone-per-cycle semantics
                 # (the cache-side job never carries it, so a fresh clone
@@ -584,7 +585,7 @@ class SchedulerCache:
                 clone.schedule_start_timestamp = None
             else:
                 clone = job.clone()
-                self._job_clone_cache[key] = (job.flat_version, clone)
+                self._job_clone_cache[key] = clone
             # resolve job priority from the PodGroup's priority class
             clone.priority = self.default_priority
             pc = self.priority_classes.get(clone.priority_class_name)

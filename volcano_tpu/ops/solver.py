@@ -504,7 +504,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
         drf_rank = drf_cap = None
 
-    def phase_rounds(st, use_future: bool, capped: bool = True):
+    def phase_rounds(st, use_future: bool, capped: bool = True, gate=None):
         """Run admission rounds to fixpoint against idle (allocate) or
         future-idle (pipeline). st: 9-tuple carry (idle, pipe, npods,
         qalloc, jobres, assigned, kind, excluded, rounds). capped=False is
@@ -575,10 +575,13 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
 
         # skip the phase outright when no task is still eligible (e.g. the
         # pipeline phase after everything allocated): one [T] reduction
-        # instead of a full wasted [T,N] round
+        # instead of a full wasted [T,N] round. `gate` adds a caller-side
+        # cheap impossibility check (no future capacity / no capped task).
         _, _, _, _, _, assigned0, _, excluded0, _ = st
         any_eligible = jnp.any(a["task_valid"] & (assigned0 < 0)
                                & ~excluded0[a["task_job"]])
+        if gate is not None:
+            any_eligible = any_eligible & gate
         out = jax.lax.while_loop(cond, body, st + (any_eligible,))
         return out[:-1]
 
@@ -586,6 +589,9 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     # task's rank (static snapshot order)
     job_first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(
         jnp.where(a["task_valid"], rank, T))
+    # loop-invariant: pipeline phases only matter when some node's
+    # FutureIdle can exceed its Idle (releasing > pipelined somewhere)
+    has_future = jnp.any(a["node_extra_future"] > 0.0)
 
     def gang_body(s):
         (idle, pipe, npods, qalloc, jobres, assigned, kind, excluded,
@@ -602,12 +608,32 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         st = (idle, pipe, npods, qalloc, jobres, assigned, kind,
               excluded | barred, rounds)
         st = phase_rounds(st, use_future=False)
-        st = phase_rounds(st, use_future=True)
+        st = phase_rounds(st, use_future=True, gate=has_future)
         if use_queue_cap and work_conserving:
             # work-conserving overflow: leftovers no competing queue could
-            # take under its cap go to whoever still wants them
-            st = phase_rounds(st, use_future=False, capped=False)
-            st = phase_rounds(st, use_future=True, capped=False)
+            # take under its cap go to whoever still wants them — run only
+            # when some leftover task is BLOCKED by the capped eligibility
+            # mask. The mask is monotone in the queue bound, so if every
+            # leftover already passes it under `deserved`, the overflow
+            # phases would see the exact eligibility the capped phases
+            # converged on and admit nothing: two full-width [T,N] rounds
+            # skipped for one [T,R] mask evaluation. (Under live DRF
+            # ordering the mask is rank-dependent; keep the phases then.)
+            (_i, _p, _n, qalloc_c, _j, assigned_c, _k, excl_c, _r) = st
+            rem = (a["task_valid"] & (assigned_c < 0)
+                   & ~excl_c[a["task_job"]])
+            if use_drf_order:
+                capped_out = jnp.any(rem)
+            else:
+                qrem_now = jnp.maximum(deserved - qalloc_c, 0.0)
+                elig_capped = _queue_cap_mask(
+                    rem, task_queue, a["task_req"], qrem_now, thr,
+                    scalar_mask, q_perm, q_seg_start)
+                capped_out = jnp.any(rem & ~elig_capped)
+            st = phase_rounds(st, use_future=False, capped=False,
+                              gate=capped_out)
+            st = phase_rounds(st, use_future=True, capped=False,
+                              gate=capped_out & has_future)
         (idle, pipe, npods, qalloc, jobres, assigned, kind, _masked,
          rounds) = st
 
