@@ -416,13 +416,26 @@ def config2_parity():
     for i in np.nonzero((a >= 0) & (k == 0))[0]:
         used[a[i]] += arr.task_req[i]
     cap_ok = bool((used <= arr.node_idle + 1e-3).all())
+    # characterize the divergence (VERDICT r2 weak #3): which jobs the two
+    # solvers disagree on, and whether the swaps trade like for like
+    counts = np.bincount(np.asarray(arr.task_job),
+                         weights=np.asarray(arr.task_valid))
+    swap_sizes = {
+        "rounds_only": [int(counts[j])
+                        for j in np.nonzero(ready1 & ~ready2)[0]],
+        "sequential_only": [int(counts[j])
+                            for j in np.nonzero(ready2 & ~ready1)[0]],
+    }
     return {
         "tasks": len(tasks), "nodes": 50,
         # under contention the rounds solver and the sequential reference
         # can satisfy different (equally valid) job subsets; report both
-        # the overlap and the work each completes
+        # the overlap and the work each completes, plus the job sizes on
+        # each side of the swap (like-for-like swaps = greedy-order
+        # deviation, not lost work)
         "job_ready_agreement": round(
             float((ready1 == ready2).mean()), 4),
+        "divergent_job_sizes": swap_sizes,
         "jobs_ready_rounds": int(ready1.sum()),
         "jobs_ready_sequential": int(ready2.sum()),
         "placed_rounds": int((a >= 0).sum()),

@@ -619,21 +619,26 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             # converged on and admit nothing: two full-width [T,N] rounds
             # skipped for one [T,R] mask evaluation. (Under live DRF
             # ordering the mask is rank-dependent; keep the phases then.)
-            (_i, _p, _n, qalloc_c, _j, assigned_c, _k, excl_c, _r) = st
-            rem = (a["task_valid"] & (assigned_c < 0)
-                   & ~excl_c[a["task_job"]])
             if use_drf_order:
-                capped_out = jnp.any(rem)
+                # rank-dependent mask: no cheap exactness argument, keep
+                # the phases (their own any-eligible check still applies)
+                st = phase_rounds(st, use_future=False, capped=False)
+                st = phase_rounds(st, use_future=True, capped=False,
+                                  gate=has_future)
             else:
+                (_i, _p, _n, qalloc_c, _j, assigned_c, _k, excl_c,
+                 _r) = st
+                rem = (a["task_valid"] & (assigned_c < 0)
+                       & ~excl_c[a["task_job"]])
                 qrem_now = jnp.maximum(deserved - qalloc_c, 0.0)
                 elig_capped = _queue_cap_mask(
                     rem, task_queue, a["task_req"], qrem_now, thr,
                     scalar_mask, q_perm, q_seg_start)
                 capped_out = jnp.any(rem & ~elig_capped)
-            st = phase_rounds(st, use_future=False, capped=False,
-                              gate=capped_out)
-            st = phase_rounds(st, use_future=True, capped=False,
-                              gate=capped_out & has_future)
+                st = phase_rounds(st, use_future=False, capped=False,
+                                  gate=capped_out)
+                st = phase_rounds(st, use_future=True, capped=False,
+                                  gate=capped_out & has_future)
         (idle, pipe, npods, qalloc, jobres, assigned, kind, _masked,
          rounds) = st
 
