@@ -7,6 +7,7 @@ and text exposition, covering the reference's metric set
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -91,10 +92,14 @@ class Histogram(_Metric):
             self._observe_locked(k, value)
 
     def _observe_locked(self, k, value: float):
+        # per-BUCKET tallies with one bisect (cumulative sums are computed
+        # at collect time): observe() runs once per bind on the replay hot
+        # path, where the previous 15-increment linear scan was measurable
+        # at 10k tasks/cycle
         counts = self._counts.setdefault(k, [0] * len(self.buckets))
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                counts[i] += 1
+        i = bisect.bisect_left(self.buckets, value)
+        if i < len(self.buckets):
+            counts[i] += 1
         self._sum[k] = self._sum.get(k, 0.0) + value
         self._n[k] = self._n.get(k, 0) + 1
 
@@ -109,7 +114,7 @@ class Histogram(_Metric):
         for k in sorted(self._n):
             cum = 0
             for i, b in enumerate(self.buckets):
-                cum = self._counts[k][i]
+                cum += self._counts[k][i]
                 lk = k + (("le", repr(b)),)
                 out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
             out.append(f"{self.name}_bucket{_fmt_labels(k + (('le', '+Inf'),))} {self._n[k]}")
