@@ -128,3 +128,52 @@ def test_allocate_action_through_sidecar(sidecar):
     get_action("allocate").execute(ssn)
     close_session(ssn)
     assert len(cache.binder.binds) == 2
+
+
+def test_hdrf_allocate_through_sidecar(sidecar):
+    """The hdrf tree arrays ride the packed layout across the socket and
+    the server honors use_hdrf_order: the rescaling split must match the
+    in-process solver path."""
+    from volcano_tpu.conf import Configuration
+
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.sidecar = sidecar
+    cache.device_cache = None
+    cache.run()
+    from helpers import build_queue
+    for name, h, w in (("root-sci", "root/sci", "100/50"),
+                       ("root-eng-dev", "root/eng/dev", "100/50/50"),
+                       ("root-eng-prod", "root/eng/prod", "100/50/50")):
+        store.apply("queues", build_queue(name, annotations={
+            "volcano.sh/hierarchy": h,
+            "volcano.sh/hierarchy-weights": w}))
+    store.create("nodes", build_node("n", {"cpu": "10", "memory": "10G"}))
+    for pg_name, q, req in (("pg1", "root-sci", {"cpu": "1", "memory": "1G"}),
+                            ("pg21", "root-eng-dev", {"cpu": "1",
+                                                      "memory": "0"}),
+                            ("pg22", "root-eng-prod", {"cpu": "0",
+                                                       "memory": "1G"})):
+        store.create("podgroups",
+                     build_pod_group(pg_name, queue=q, min_member=1))
+        for i in range(10):
+            store.create("pods", build_pod(
+                "default", f"{pg_name}-p{i}", "", "Pending", req, pg_name))
+    tiers = [Tier(plugins=[
+        PluginOption(name="drf", arguments={"drf.enableHierarchy": True}),
+        PluginOption(name="gang"),
+        PluginOption(name="predicates"),
+        PluginOption(name="nodeorder")])]
+    ssn = open_session(cache, tiers,
+                       [Configuration("allocate", {"mode": "solver"})])
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    alloc = {}
+    for key in cache.binder.binds:
+        pg = key.split("/")[1].rsplit("-p", 1)[0]
+        alloc[pg] = alloc.get(pg, 0) + 1
+    assert alloc.get("pg1", 0) == 5, alloc
+    assert alloc.get("pg21", 0) == 5, alloc
+    assert alloc.get("pg22", 0) == 5, alloc

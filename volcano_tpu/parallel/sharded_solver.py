@@ -54,7 +54,8 @@ def make_mesh(devices=None, axis: str = "n") -> Mesh:
                                              "max_gang_iters", "herd_mode",
                                              "score_families",
                                              "use_queue_cap",
-                                             "use_drf_order"))
+                                             "use_drf_order",
+                                             "use_hdrf_order"))
 def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            score_params: Dict[str, jnp.ndarray],
                            mesh: Mesh,
@@ -63,7 +64,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            herd_mode: str = "pack",
                            score_families: Tuple[str, ...] = ("binpack",),
                            use_queue_cap: bool = False,
-                           use_drf_order: bool = False) -> SolveResult:
+                           use_drf_order: bool = False,
+                           use_hdrf_order: bool = False) -> SolveResult:
     a = arrays
     T = a["task_init_req"].shape[0]
     N = a["node_idle"].shape[0]
@@ -95,6 +97,16 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         # live DRF ordering: shares are [J] reductions over replicated
         # job state, identical on every device
         in_specs.update({"job_drf_allocated": P(), "drf_total": P()})
+    if use_hdrf_order:
+        # hierarchical DRF: the queue-path tree is tiny and its share
+        # recursion runs on replicated [H]/[J] state (ops/hdrf.py).
+        # Meaningless without the DRF ordering machinery it replaces.
+        assert use_drf_order, "use_hdrf_order requires use_drf_order"
+        in_specs.update({
+            "hdrf_parent": P(), "hdrf_weight": P(), "hdrf_depth": P(),
+            "hdrf_is_leaf": P(), "hdrf_leaf_req": P(),
+            "hdrf_job_leaf": P(), "hdrf_ancestors": P(),
+            "hdrf_total_allocated": P()})
     params_spec = {k: (P("n") if k == "node_static" else P())
                    for k in score_params}
 
@@ -117,6 +129,9 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
 
         if use_drf_order:
             jobres0, drf_rank, drf_cap = drf_state(a, rank)
+            if use_hdrf_order:
+                from ..ops.hdrf import hdrf_rank_state
+                drf_rank = hdrf_rank_state(a, rank)
         else:
             jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
