@@ -260,6 +260,226 @@ class TestStatement:
         assert events == [("alloc", "p0"), ("dealloc", "p0")]
 
 
+class TestStatementBulk:
+    """allocate_bulk / bind_batch must be observationally identical to the
+    per-task allocate/commit loop (the burst replay runs through them)."""
+
+    def _open(self, pods=4, min_member=4):
+        return make_session([], pods=pods, min_member=min_member)
+
+    def _state(self, ssn, cache):
+        job = ssn.jobs["ns1/pg1"]
+        node = ssn.nodes["n1"]
+        cjob = cache.jobs["ns1/pg1"]
+        cnode = cache.nodes["n1"]
+        return {
+            "statuses": {k: t.status for k, t in job.tasks.items()},
+            "node_names": {k: t.node_name for k, t in job.tasks.items()},
+            "idle": (node.idle.milli_cpu, node.idle.memory),
+            "used": (node.used.milli_cpu, node.used.memory),
+            "node_tasks": set(node.tasks),
+            "allocated": (job.allocated.milli_cpu, job.allocated.memory),
+            "pending": (job.pending_request.milli_cpu,
+                        job.pending_request.memory),
+            "index": {s: set(m) for s, m in job.task_status_index.items()},
+            "cache_statuses": {k: t.status for k, t in cjob.tasks.items()},
+            "cache_idle": (cnode.idle.milli_cpu, cnode.idle.memory),
+            "cache_node_tasks": set(cnode.tasks),
+            "cache_allocated": (cjob.allocated.milli_cpu,
+                                cjob.allocated.memory),
+            "binds": dict(cache.binder.binds),
+        }
+
+    def test_bulk_matches_per_task(self):
+        # same cluster, two paths: state must match field for field
+        store1, cache1, ssn1 = self._open()
+        stmt1 = ssn1.statement(defer_events=True)
+        tasks1 = sorted(ssn1.jobs["ns1/pg1"].tasks.values(),
+                        key=lambda t: t.name)
+        for t in tasks1:
+            stmt1.allocate(t, "n1")
+        stmt1.commit()
+
+        store2, cache2, ssn2 = self._open()
+        stmt2 = ssn2.statement(defer_events=True)
+        tasks2 = sorted(ssn2.jobs["ns1/pg1"].tasks.values(),
+                        key=lambda t: t.name)
+        failures = stmt2.allocate_bulk([(t, "n1") for t in tasks2])
+        assert failures == []
+        stmt2.commit()
+
+        assert self._state(ssn1, cache1) == self._state(ssn2, cache2)
+
+    def test_bulk_discard_restores(self):
+        store, cache, ssn = self._open()
+        before = self._state(ssn, cache)
+        stmt = ssn.statement(defer_events=True)
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(),
+                       key=lambda t: t.name)
+        assert stmt.allocate_bulk([(t, "n1") for t in tasks]) == []
+        assert ssn.nodes["n1"].idle.milli_cpu == 8000 - 4000
+        stmt.discard()
+        assert self._state(ssn, cache) == before
+
+    def test_bulk_events_fire_per_task(self):
+        store, cache, ssn = self._open()
+        events = []
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda e: events.append(e.task.name)))
+        stmt = ssn.statement()  # live events
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(),
+                       key=lambda t: t.name)
+        assert stmt.allocate_bulk([(t, "n1") for t in tasks]) == []
+        assert sorted(events) == [t.name for t in tasks]
+
+    def test_bulk_unknown_node_matches_per_task_leniency(self):
+        # Statement.allocate is lenient about a missing node (no node
+        # accounting, task still marked); the bulk path must match
+        store, cache, ssn = self._open()
+        stmt = ssn.statement(defer_events=True)
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(),
+                       key=lambda t: t.name)
+        pairs = [(tasks[0], "n1"), (tasks[1], "ghost"),
+                 (tasks[2], "n1"), (tasks[3], "n1")]
+        assert stmt.allocate_bulk(pairs) == []
+        # the three real placements applied; the ghost one skipped node
+        # accounting exactly like per-task allocate()
+        assert ssn.nodes["n1"].idle.milli_cpu == 8000 - 3000
+        assert tasks[1].status == TaskStatus.ALLOCATED
+        assert tasks[1].node_name == "ghost"
+        assert len(stmt.operations) == 4
+
+    def test_bulk_overcommit_falls_back_per_task(self):
+        # a wave that exceeds idle as a whole must behave like the
+        # sequential loop: earlier tasks take node accounting, later ones
+        # raise out of add_task and surface as failures
+        from volcano_tpu.framework import open_session
+        store = ClusterStore()
+        cache = SchedulerCache(store)
+        cache.binder = FakeBinder()
+        cache.evictor = FakeEvictor()
+        cache.run()
+        store.create("nodes", build_node("n1", {"cpu": "8",
+                                                "memory": "16Gi"}))
+        store.create("podgroups", build_pod_group("pg1", "ns1",
+                                                  min_member=1))
+        for i in range(4):
+            store.create("pods", build_pod(
+                "ns1", f"p{i}", "", "Pending",
+                {"cpu": "3", "memory": "1Gi"}, "pg1"))
+        ssn = open_session(cache, [])
+        stmt = ssn.statement(defer_events=True)
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(),
+                       key=lambda t: t.name)
+        failures = stmt.allocate_bulk([(t, "n1") for t in tasks])
+        # 8000 idle / 3000 per task -> 2 take accounting, 2 raise
+        assert [t.name for t, _, _ in failures] == ["p2", "p3"]
+        assert ssn.nodes["n1"].idle.milli_cpu == 8000 - 6000
+        assert len(ssn.nodes["n1"].tasks) == 2
+
+    def test_add_tasks_bulk_unvalidated_checks_itself(self):
+        # the validated=False path must run the same checks the callers do
+        store, cache, ssn = self._open()
+        node = ssn.nodes["n1"]
+        job = ssn.jobs["ns1/pg1"]
+        tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+        for t in tasks:
+            job.update_task_status(t, TaskStatus.ALLOCATED)
+        node.add_tasks_bulk(tasks[:2])
+        assert node.idle.milli_cpu == 8000 - 2000
+        assert set(node.tasks) == {"ns1/p0", "ns1/p1"}
+        # a duplicate key falls back per task and raises like add_task
+        with pytest.raises(ValueError):
+            node.add_tasks_bulk([tasks[0]])
+
+    def test_bind_batch_partial_fit_demotes_with_input_objects(self):
+        # a group that doesn't fit as a whole must bind the fitting prefix
+        # per task and report failures with the CALLER's task objects
+        store, cache, ssn = self._open()
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(),
+                       key=lambda t: t.name)
+        stmt = ssn.statement()
+        for t in tasks:
+            stmt.allocate(t, "n1")
+        # shrink the cache-side node so only two of the four fit
+        cache.nodes["n1"].idle.milli_cpu = 2000.0
+        failures = cache.bind_batch(tasks)
+        assert [t.name for t, _ in failures] == ["p2", "p3"]
+        assert all(t is tasks[i + 2] for i, (t, _) in enumerate(failures))
+        assert cache.jobs["ns1/pg1"].tasks["ns1/p0"].status \
+            == TaskStatus.BINDING
+        assert cache.jobs["ns1/pg1"].tasks["ns1/p2"].status \
+            != TaskStatus.BINDING
+
+    def test_bulk_duplicate_task_raises_like_per_task(self):
+        # the same task twice in one wave: first applies, second surfaces
+        # the per-task 'already on node' failure — never double accounting
+        store, cache, ssn = self._open()
+        stmt = ssn.statement(defer_events=True)
+        tasks = sorted(ssn.jobs["ns1/pg1"].tasks.values(),
+                       key=lambda t: t.name)
+        failures = stmt.allocate_bulk([(tasks[0], "n1"), (tasks[0], "n1")])
+        assert len(failures) == 1 and failures[0][0] is tasks[0]
+        assert ssn.nodes["n1"].idle.milli_cpu == 8000 - 1000
+        job = ssn.jobs["ns1/pg1"]
+        assert job.allocated.milli_cpu == 1000
+
+    def test_bulk_aggregate_drift_demotes_job(self):
+        # a drifted pending aggregate must not abort the cycle or leave a
+        # half-mutated job: bulk pre-checks, fails closed to per-task
+        store, cache, ssn = self._open()
+        job = ssn.jobs["ns1/pg1"]
+        job.pending_request.milli_cpu = 0.0  # simulate drift
+        stmt = ssn.statement(defer_events=True)
+        tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+        failures = stmt.allocate_bulk([(t, "n1") for t in tasks])
+        # per-task path: each update_task_status raises the same ValueError
+        assert len(failures) == 4
+        assert all(isinstance(e, ValueError) for _, _, e in failures)
+
+        # parity: the per-task loop on an identical cluster ends in the
+        # same (quirky: status flips before the aggregate assert) state
+        store2, cache2, ssn2 = self._open()
+        job2 = ssn2.jobs["ns1/pg1"]
+        job2.pending_request.milli_cpu = 0.0
+        stmt2 = ssn2.statement(defer_events=True)
+        tasks2 = sorted(job2.tasks.values(), key=lambda t: t.name)
+        raised = 0
+        for t in tasks2:
+            try:
+                stmt2.allocate(t, "n1")
+            except ValueError:
+                raised += 1
+        assert raised == 4
+        assert {k: t.status for k, t in job.tasks.items()} \
+            == {k: t.status for k, t in job2.tasks.items()}
+        assert ssn.nodes["n1"].idle.milli_cpu \
+            == ssn2.nodes["n1"].idle.milli_cpu
+        assert job.allocated.milli_cpu == job2.allocated.milli_cpu
+
+    def test_bind_batch_matches_bind(self):
+        store1, cache1, ssn1 = self._open()
+        tasks1 = sorted(ssn1.jobs["ns1/pg1"].tasks.values(),
+                        key=lambda t: t.name)
+        stmt1 = ssn1.statement()
+        for t in tasks1:
+            stmt1.allocate(t, "n1")
+        for t in tasks1:
+            cache1.bind(t, "n1")
+
+        store2, cache2, ssn2 = self._open()
+        tasks2 = sorted(ssn2.jobs["ns1/pg1"].tasks.values(),
+                        key=lambda t: t.name)
+        stmt2 = ssn2.statement()
+        for t in tasks2:
+            stmt2.allocate(t, "n1")
+        assert cache2.bind_batch(tasks2) == []
+
+        assert self._state(ssn1, cache1) == self._state(ssn2, cache2)
+        assert cache2.jobs["ns1/pg1"].tasks["ns1/p0"].status \
+            == TaskStatus.BINDING
+
+
 class TestPriorityQueue:
     def test_order_and_stability(self):
         pq = PriorityQueue(lambda l, r: l[0] < r[0])

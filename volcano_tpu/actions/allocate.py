@@ -322,32 +322,44 @@ class AllocateAction(Action):
         t0 = _time.perf_counter()
 
         # replay through the Statement boundary in job order; events fire
-        # as one batch per committed job (identical final handler state —
-        # handlers are additive — at a tenth of the per-task cost)
+        # as one batch per committed job and each job's accounting applies
+        # as one bulk Statement wave (identical final handler/session state
+        # — see Statement.allocate_bulk — at a fraction of the per-task
+        # cost; the per-task loop blew the 1 s period on a 10k burst)
+        assigned = assigned.tolist()  # plain ints: no np scalar per lookup
+        kind = kind.tolist()
+        nodes_list = arr.nodes_list
         idx = 0
         for job, tasks in job_order:
             stmt = ssn.statement(defer_events=True)
+            pairs = []
             for task in tasks:
                 t_idx = idx
                 idx += 1
-                node_idx = int(assigned[t_idx])
+                node_idx = assigned[t_idx]
                 if node_idx < 0:
                     fe = FitErrors()
                     fe.set_error(ALL_NODES_UNAVAILABLE)
                     job.nodes_fit_errors[task.key] = fe
                     continue
-                node_name = arr.nodes_list[node_idx].name
+                node_name = nodes_list[node_idx].name
+                if kind[t_idx] == 0:
+                    pairs.append((task, node_name))
+                    continue
                 try:
-                    if kind[t_idx] == 0:
-                        stmt.allocate(task, node_name)
-                    else:
-                        ssn.pipeline(task, node_name)
+                    ssn.pipeline(task, node_name)
                 except (KeyError, ValueError) as e:
                     log.exception("replay failed for %s", task.key)
                     fe = FitErrors()
                     fe.set_node_error(node_name, FitError(
                         task, node_name, [str(e)]))
                     job.nodes_fit_errors[task.key] = fe
+            for task, node_name, e in stmt.allocate_bulk(pairs):
+                log.error("replay failed for %s", task.key, exc_info=e)
+                fe = FitErrors()
+                fe.set_node_error(node_name, FitError(
+                    task, node_name, [str(e)]))
+                job.nodes_fit_errors[task.key] = fe
             if ssn.job_ready(job):
                 stmt.commit()
             else:

@@ -224,6 +224,84 @@ class JobInfo:
         ti.status = status
         self.add_task_info(ti)
 
+    def bulk_update_status(self, tasks, status: TaskStatus) -> None:
+        """update_task_status over a whole wave in one pass: index entries
+        move via bulk dict ops and the allocated/pending aggregates take one
+        summed delta per distinct old status instead of a Resource op per
+        task. Observable state is identical to the per-task loop; tasks that
+        are not the stored objects fall back to update_task_status (after
+        the stored-object part). Used by the solver replay and the batched
+        bind (a 10k-pod burst pays ~68us of per-task Python through the
+        scalar path, VERDICT r3).
+
+        Atomic on failure for the stored-object part: every aggregate
+        subtraction is pre-checked with the same tolerant less_equal sub()
+        asserts, so a ValueError raises BEFORE any index or aggregate
+        mutation — callers demote the wave to the per-task path, which has
+        partial-application semantics the Statement can undo."""
+        by_old: Dict[TaskStatus, list] = {}
+        foreign: list = []
+        for ti in tasks:
+            if self.tasks.get(ti.key) is ti:
+                if ti.status != status:
+                    by_old.setdefault(ti.status, []).append(ti)
+            else:
+                foreign.append(ti)
+        if by_old:
+            now = allocated_status(status)
+            deltas = []
+            alloc_sub = []
+            pending_sub = []
+            for old, group in by_old.items():
+                was = allocated_status(old)
+                total = None
+                if was != now or (old == TaskStatus.PENDING) != (
+                        status == TaskStatus.PENDING):
+                    total = Resource.sum_of(t.resreq for t in group)
+                    if was and not now:
+                        alloc_sub.append(total)
+                    if old == TaskStatus.PENDING \
+                            and status != TaskStatus.PENDING:
+                        pending_sub.append(total)
+                deltas.append((old, group, total, was))
+            # pre-check the COMBINED subtraction per aggregate (groups may
+            # share one) so no sub() can assert after mutation started
+            if alloc_sub and not Resource.sum_of(
+                    alloc_sub).less_equal(self.allocated):
+                raise ValueError(
+                    f"bulk status change to {status} exceeds job "
+                    f"<{self.uid}> allocated aggregate")
+            if pending_sub and not Resource.sum_of(
+                    pending_sub).less_equal(self.pending_request):
+                raise ValueError(
+                    f"bulk status change to {status} exceeds job "
+                    f"<{self.uid}> pending aggregate")
+            new_bucket = self.task_status_index.setdefault(status, {})
+            for old, group, total, was in deltas:
+                bucket = self.task_status_index.get(old)
+                if bucket is not None:
+                    for ti in group:
+                        bucket.pop(ti.key, None)
+                    if not bucket:
+                        del self.task_status_index[old]
+                for ti in group:
+                    ti.status = status
+                    new_bucket[ti.key] = ti
+                if total is not None:
+                    if was and not now:
+                        self.allocated.sub(total)
+                    elif now and not was:
+                        self.allocated.add(total)
+                    if old == TaskStatus.PENDING \
+                            and status != TaskStatus.PENDING:
+                        self.pending_request.sub(total)
+                    elif status == TaskStatus.PENDING \
+                            and old != TaskStatus.PENDING:
+                        self.pending_request.add(total)
+            self.flat_version = next_flat_version()
+        for ti in foreign:
+            self.update_task_status(ti, status)
+
     # -- gang readiness -----------------------------------------------------
 
     def ready_task_num(self) -> int:

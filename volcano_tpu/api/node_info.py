@@ -134,14 +134,16 @@ class NodeInfo:
                 for dev_id, dev in self.gpu_devices.items()}
 
     def add_gpu_resource(self, pod) -> None:
-        if gpu_resource_of_pod(pod) <= 0:
+        # empty-dict check first: most nodes have no shared GPUs, and this
+        # runs once per task on the replay/bind hot path
+        if not self.gpu_devices or gpu_resource_of_pod(pod) <= 0:
             return
         dev = self.gpu_devices.get(get_gpu_index(pod))
         if dev is not None:
             dev.pod_map[pod.uid] = pod
 
     def sub_gpu_resource(self, pod) -> None:
-        if gpu_resource_of_pod(pod) <= 0:
+        if not self.gpu_devices or gpu_resource_of_pod(pod) <= 0:
             return
         dev = self.gpu_devices.get(get_gpu_index(pod))
         if dev is not None:
@@ -181,6 +183,60 @@ class NodeInfo:
         ti.node_name = self.name
         self.tasks[ti.key] = ti
         self.add_gpu_resource(ti.pod)
+
+    def add_tasks_bulk(self, tasks, validated: bool = False) -> None:
+        """add_task over a wave with one summed accounting update. Only
+        allocated-status tasks qualify (the replay/bind path: ALLOCATED or
+        BINDING waves); anything else — or any per-task validation failure,
+        or a wave that doesn't fit idle as a whole — falls back to the
+        per-task loop so partial-application + raise semantics stay exactly
+        add_task's. ``validated=True`` asserts the caller already ran these
+        exact checks (Statement.allocate_bulk / SchedulerCache.bind_batch
+        validate per node group before any mutation) so they aren't paid
+        twice per task on the replay hot path."""
+        fast = self.node is not None
+        if fast and not validated:
+            seen = set()
+            for t in tasks:
+                if (t.node_name and self.name and t.node_name != self.name) \
+                        or t.key in self.tasks or t.key in seen \
+                        or t.status in (TaskStatus.RELEASING,
+                                        TaskStatus.PIPELINED):
+                    fast = False
+                    break
+                seen.add(t.key)
+            if fast and not Resource.sum_of(
+                    t.resreq for t in tasks).less_equal(self.idle):
+                fast = False
+        if not fast:
+            for t in tasks:
+                self.add_task(t)
+            return
+        self.flat_version = next_flat_version()
+        # fit was checked wave-wide (the same tolerant less_equal sub()
+        # asserts); apply the deltas without paying per-dimension checks
+        # again
+        idle = self.idle
+        used = self.used
+        name = self.name
+        node_tasks = self.tasks
+        for task in tasks:
+            rr = task.resreq
+            idle.milli_cpu -= rr.milli_cpu
+            idle.memory -= rr.memory
+            used.milli_cpu += rr.milli_cpu
+            used.memory += rr.memory
+            if rr.scalars:
+                isc = idle.scalars
+                usc = used.scalars
+                for k, v in rr.scalars.items():
+                    isc[k] = isc.get(k, 0.0) - v
+                    usc[k] = usc.get(k, 0.0) + v
+            ti = task.clone()
+            task.node_name = name
+            ti.node_name = name
+            node_tasks[ti.key] = ti
+            self.add_gpu_resource(ti.pod)
 
     def remove_task(self, ti: TaskInfo) -> None:
         task = self.tasks.get(ti.key)

@@ -101,6 +101,26 @@ class Resource:
         r.max_task_num = self.max_task_num
         return r
 
+    @classmethod
+    def sum_of(cls, items: Iterable["Resource"]) -> "Resource":
+        """Sum many Resources with one result object (the bulk replay/bind
+        paths aggregate a job's whole wave into a single accounting delta
+        instead of a Resource op per task)."""
+        r = cls.__new__(cls)
+        mc = mem = 0.0
+        sc: Dict[str, float] = {}
+        for it in items:
+            mc += it.milli_cpu
+            mem += it.memory
+            if it.scalars:
+                for k, v in it.scalars.items():
+                    sc[k] = sc.get(k, 0.0) + v
+        r.milli_cpu = mc
+        r.memory = mem
+        r.scalars = sc
+        r.max_task_num = 0
+        return r
+
     # -- predicates ---------------------------------------------------------
 
     def is_empty(self) -> bool:

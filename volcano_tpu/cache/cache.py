@@ -111,6 +111,27 @@ class DefaultVolumeBinder:
         # reverse index for O(1) pin lookups on the predicate hot path
         self._assumed_by_claim: Dict[tuple, str] = {}
 
+    def has_assumed(self) -> bool:
+        """Whether any pod holds an in-flight volume assumption — when not,
+        bind_volumes is a no-op for every task and batch commits skip the
+        per-task calls entirely."""
+        return bool(self._assumed)
+
+    def allocate_volumes_batch(self, pairs) -> list:
+        """allocate_volumes over [(task, hostname)]; returns
+        [(task, hostname, exc)] failures. Volume-less pods (the typical
+        burst) skip straight to volume_ready."""
+        failures = []
+        for task, hostname in pairs:
+            if not getattr(task.pod, "volumes", None):
+                task.volume_ready = True
+                continue
+            try:
+                self.allocate_volumes(task, hostname)
+            except (KeyError, ValueError) as e:
+                failures.append((task, hostname, e))
+        return failures
+
     @staticmethod
     def _claims(pod):
         for vol in getattr(pod, "volumes", None) or []:
@@ -634,6 +655,123 @@ class SchedulerCache:
 
         self._dispatch_effect(effect, failed, f"bind {task.key}")
 
+    def bind_batch(self, tis) -> list:
+        """Batched bind(): identical per-task cache state, but one
+        accounting pass per (job, node) group and ONE dispatched effect for
+        the whole wave — bind() dispatches an effect per task
+        (cache.go:450-478's per-goroutine shape), which at a 10k-pod burst
+        is most of the replay's host cost. Returns [(ti, exc)] for tasks
+        whose cache-side accounting failed, carrying the same exceptions
+        bind() would have raised; those tasks get no effect."""
+        failures: list = []
+        bound: list = []
+        starts: list = []
+        slow: list = []
+        by_node: Dict[str, list] = {}
+        last_jobid = None  # statements commit per job: one lookup suffices
+        job = None
+        seen = set()
+        for ti in tis:
+            if ti.job != last_jobid:
+                job = self.jobs.get(ti.job)
+                last_jobid = ti.job
+            task = job.tasks.get(ti.key) if job is not None else None
+            # duplicates within the wave go per-task: the second bind()
+            # raises 'already on node' instead of double-counting
+            if task is None or task.key in seen:
+                slow.append(ti)
+                continue
+            seen.add(task.key)
+            group = by_node.get(ti.node_name)
+            if group is None:
+                by_node[ti.node_name] = [(ti, job, task)]
+            else:
+                group.append((ti, job, task))
+        # each node group is validated up front (same checks bind() relies
+        # on, whole-group fit included) so the bulk mutators cannot raise
+        # mid-wave; invalid groups demote to per-task bind()
+        fast_nodes = []
+        for hostname, group in by_node.items():
+            node = self.nodes.get(hostname)
+            ok = node is not None and node.node is not None
+            if ok:
+                node_tasks = node.tasks
+                for _, _, task in group:
+                    if task.key in node_tasks or (
+                            task.node_name and task.node_name != hostname):
+                        ok = False
+                        break
+            if ok:
+                req = group[0][2].resreq if len(group) == 1 \
+                    else Resource.sum_of(t.resreq for _, _, t in group)
+                ok = req.less_equal(node.idle)
+            if ok:
+                fast_nodes.append((node, group))
+            else:
+                # demote the ORIGINAL input objects: bind() re-resolves its
+                # own task and the failure tuples must hand callers back
+                # what they gave us, never cache-side objects
+                slow.extend(ti for ti, _, _ in group)
+        by_job: Dict[str, tuple] = {}
+        for node, group in fast_nodes:
+            for ent3 in group:
+                ent = by_job.get(ent3[2].job)
+                if ent is None:
+                    by_job[ent3[2].job] = (ent3[1], [ent3])
+                else:
+                    ent[1].append(ent3)
+        demoted = set()
+        for job, group in by_job.values():
+            try:
+                # raises BEFORE mutating (aggregates pre-checked): the
+                # job's wave demotes to per-task bind() on failure
+                job.bulk_update_status([t for _, _, t in group],
+                                       TaskStatus.BINDING)
+            except (KeyError, ValueError):
+                demoted.update(id(t) for _, _, t in group)
+                continue
+            start = job.schedule_start_timestamp
+            for _, _, task in group:
+                bound.append(task)
+                starts.append(start or task.pod.creation_timestamp or 0.0)
+        for node, group in fast_nodes:
+            if demoted:
+                kept = [e for e in group if id(e[2]) not in demoted]
+                slow.extend(e[0] for e in group if id(e[2]) in demoted)
+                if not kept:
+                    continue
+                group = kept
+            node.add_tasks_bulk([t for _, _, t in group], validated=True)
+        for ti in slow:
+            try:
+                self.bind(ti, ti.node_name)
+            except (KeyError, ValueError) as e:
+                failures.append((ti, e))
+        if bound:
+            def effect():
+                ok = 0
+                lat = []
+                for task, start in zip(bound, starts):
+                    try:
+                        self.binder.bind(task.pod, task.node_name)
+                    except Exception:
+                        log.exception("bind %s failed", task.key)
+                        metrics.schedule_attempts.inc(
+                            labels={"result": "error"})
+                        self.resync_task(task)
+                        continue
+                    ok += 1
+                    if start:
+                        lat.append((time.time() - start) * 1e3)
+                if ok:
+                    metrics.schedule_attempts.inc(
+                        ok, labels={"result": "scheduled"})
+                metrics.task_scheduling_latency.observe_many(lat)
+
+            self._dispatch_effect(effect, lambda: None,
+                                  f"bind batch of {len(bound)}")
+        return failures
+
     def evict(self, ti: TaskInfo, reason: str) -> None:
         job, task = self._find_job_and_task(ti)
         node = self.nodes.get(task.node_name)
@@ -681,8 +819,39 @@ class SchedulerCache:
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         self.volume_binder.allocate_volumes(task, hostname)
 
+    def allocate_volumes_batch(self, pairs) -> list:
+        """Batched allocate_volumes; [(task, hostname, exc)] failures."""
+        vb = self.volume_binder
+        batch = getattr(vb, "allocate_volumes_batch", None)
+        if batch is not None:
+            return batch(pairs)
+        failures = []
+        for task, hostname in pairs:
+            try:
+                vb.allocate_volumes(task, hostname)
+            except (KeyError, ValueError) as e:
+                failures.append((task, hostname, e))
+        return failures
+
     def bind_volumes(self, task: TaskInfo) -> None:
         self.volume_binder.bind_volumes(task)
+
+    def bind_volumes_batch(self, tasks) -> list:
+        """bind_volumes over a wave; returns [(task, exc)] failures. When
+        the volume binder reports no in-flight assumptions at all, the
+        whole wave is a no-op and the per-task calls are skipped (the
+        common case: a 10k-pod burst of volume-less pods)."""
+        vb = self.volume_binder
+        pending = getattr(vb, "has_assumed", None)
+        if pending is not None and not pending():
+            return []
+        failures = []
+        for t in tasks:
+            try:
+                vb.bind_volumes(t)
+            except Exception as e:  # noqa: BLE001 — mirrors bind failure path
+                failures.append((t, e))
+        return failures
 
     def revert_volumes(self, task: TaskInfo) -> None:
         revert = getattr(self.volume_binder, "revert_volumes", None)

@@ -13,7 +13,7 @@ import logging
 from dataclasses import dataclass
 from typing import List
 
-from ..api import TaskInfo, TaskStatus
+from ..api import Resource, TaskInfo, TaskStatus
 
 log = logging.getLogger(__name__)
 
@@ -115,6 +115,120 @@ class Statement:
             self.ssn._fire_allocate(task)
         self.operations.append(_Operation(Op.ALLOCATE, task))
 
+    def allocate_bulk(self, pairs) -> list:
+        """allocate() over a whole assignment wave ([(task, hostname)]) in
+        one pass: volume assumptions, events and undo records keep their
+        per-task semantics, while job/node accounting is applied as bulk
+        index moves + one summed resource delta per (job, node) group.
+        Pairs the fast path can't take (missing job/node, wave that doesn't
+        fit, foreign objects) go through plain allocate() instead. Returns
+        [(task, hostname, exc)] for pairs that failed — the same exceptions
+        allocate() would have raised (callers record FitErrors from
+        them)."""
+        ssn = self.ssn
+        failures = []
+        slow = []
+        vol_batch = getattr(ssn.cache, "allocate_volumes_batch", None)
+        if vol_batch is not None:
+            vol_failures = vol_batch(pairs)
+            if vol_failures:
+                failures.extend(vol_failures)
+                failed = {id(t) for t, _, _ in vol_failures}
+                pairs = [(t, h) for t, h in pairs if id(t) not in failed]
+        by_node = {}
+        jobs = ssn.jobs
+        last_jobid = None  # replay waves are per-job: one lookup suffices
+        job = None
+        seen = set()
+        for task, hostname in pairs:
+            if vol_batch is None:
+                try:
+                    ssn.cache.allocate_volumes(task, hostname)
+                except (KeyError, ValueError) as e:
+                    failures.append((task, hostname, e))
+                    continue
+            if task.job != last_jobid:
+                job = jobs.get(task.job)
+                last_jobid = task.job
+            key = task.key
+            # slow-path pairs: unknown job, a task that is not the job's
+            # stored object (bulk_update_status would quietly route it but
+            # the atomicity argument needs stored-only waves), or a
+            # duplicate within the wave (the per-task loop raises on the
+            # second occurrence; the wave must not double-count it)
+            if job is None or job.tasks.get(key) is not task \
+                    or key in seen:
+                slow.append((task, hostname))
+                continue
+            seen.add(key)
+            group = by_node.get(hostname)
+            if group is None:
+                by_node[hostname] = [task]
+            else:
+                group.append(task)
+        # the fast path must be unable to raise mid-wave (a partial bulk
+        # mutation would leave applied tasks without undo records), so each
+        # node group is validated with the same checks add_task makes —
+        # whole-group fit included — and demoted to the per-task path
+        # otherwise, whose partial-application + raise semantics the caller
+        # already handles
+        fast_nodes = []
+        bad = (TaskStatus.RELEASING, TaskStatus.PIPELINED)
+        for hostname, tasks in by_node.items():
+            node = ssn.nodes.get(hostname)
+            ok = node is not None and node.node is not None
+            if ok:
+                node_tasks = node.tasks
+                for t in tasks:
+                    if (t.node_name and t.node_name != hostname) \
+                            or t.key in node_tasks or t.status in bad:
+                        ok = False
+                        break
+            if ok:
+                req = tasks[0].resreq if len(tasks) == 1 \
+                    else Resource.sum_of(t.resreq for t in tasks)
+                ok = req.less_equal(node.idle)
+            if ok:
+                fast_nodes.append((node, tasks))
+            else:
+                slow.extend((t, hostname) for t in tasks)
+        by_job = {}
+        for node, tasks in fast_nodes:
+            for t in tasks:
+                by_job.setdefault(t.job, []).append(t)
+        demoted = set()
+        for jobid, tasks in by_job.items():
+            try:
+                # raises BEFORE mutating (aggregates pre-checked), so a
+                # failed job's whole wave can still demote to the per-task
+                # path and surface per-task failures
+                ssn.jobs[jobid].bulk_update_status(
+                    tasks, TaskStatus.ALLOCATED)
+            except (KeyError, ValueError):
+                demoted.update(id(t) for t in tasks)
+        ops = self.operations
+        for node, tasks in fast_nodes:
+            if demoted:
+                kept = [t for t in tasks if id(t) not in demoted]
+                slow.extend((t, node.name) for t in tasks
+                            if id(t) in demoted)
+                if not kept:
+                    continue
+                tasks = kept
+            node.add_tasks_bulk(tasks, validated=True)
+            if not self.defer_events:
+                for task in tasks:
+                    ssn._fire_allocate(task)
+            for task in tasks:
+                ops.append(_Operation(Op.ALLOCATE, task))
+        for task, hostname in slow:
+            try:
+                # volumes were already assumed; re-assuming is idempotent
+                self.allocate(task, hostname)
+            except (KeyError, ValueError) as e:
+                failures.append((task, hostname, e))
+        return failures
+
     def _commit_allocate(self, task: TaskInfo) -> None:
         try:
             self.ssn.cache.bind_volumes(task)
@@ -146,6 +260,37 @@ class Statement:
             self.ssn._fire_allocate_batch(
                 [op.task for op in self.operations
                  if op.name == Op.ALLOCATE])
+        bind_batch = getattr(self.ssn.cache, "bind_batch", None)
+        if bind_batch is not None and len(self.operations) > 1 and all(
+                op.name == Op.ALLOCATE for op in self.operations):
+            # pure-allocate statement (the solver replay shape): volumes
+            # bind as one wave, then ONE batched cache bind — identical
+            # cache state and failure handling to the per-op loop, without
+            # its per-task dispatch cost
+            cache = self.ssn.cache
+            tasks = [op.task for op in self.operations]
+            vb_batch = getattr(cache, "bind_volumes_batch", None)
+            if vb_batch is not None:
+                vol_failures = vb_batch(tasks)
+            else:
+                vol_failures = []
+                for task in tasks:
+                    try:
+                        cache.bind_volumes(task)
+                    except Exception as e:  # noqa: BLE001
+                        vol_failures.append((task, e))
+            if vol_failures:
+                failed = {id(t) for t, _ in vol_failures}
+                tasks = [t for t in tasks if id(t) not in failed]
+                for task, exc in vol_failures:
+                    log.error("commit bind_volumes failed for %s: %s",
+                              task.key, exc)
+                    self._unallocate(task)
+            for task, exc in bind_batch(tasks):
+                log.error("commit bind failed for %s: %s", task.key, exc)
+                self._unallocate(task)
+            self.operations = []
+            return
         for op in self.operations:
             try:
                 if op.name == Op.EVICT:

@@ -103,6 +103,26 @@ class Histogram(_Metric):
         self._sum[k] = self._sum.get(k, 0.0) + value
         self._n[k] = self._n.get(k, 0) + 1
 
+    def observe_many(self, values, labels: Optional[Dict[str, str]] = None):
+        """Batch observe: one lock/key resolution for a whole wave of
+        samples (the batched bind effector observes per task; a 10k-pod
+        burst is 10k samples)."""
+        values = list(values)
+        if not values:
+            return
+        k = _label_key(labels)
+        with _metrics_lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            total = 0.0
+            nb = len(self.buckets)
+            for value in values:
+                i = bisect.bisect_left(self.buckets, value)
+                if i < nb:
+                    counts[i] += 1
+                total += value
+            self._sum[k] = self._sum.get(k, 0.0) + total
+            self._n[k] = self._n.get(k, 0) + len(values)
+
     def get_count(self, labels=None) -> int:
         return self._n.get(_label_key(labels), 0)
 

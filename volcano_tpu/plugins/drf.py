@@ -458,13 +458,15 @@ class DRFPlugin(Plugin):
         def on_allocate_batch(tasks):
             """Additive form of on_allocate: one aggregate add + one share
             recompute per job (shares depend only on totals)."""
-            by_job: Dict[str, Resource] = {}
+            by_job: Dict[str, list] = {}
             for t in tasks:
-                agg = by_job.get(t.job)
-                if agg is None:
-                    by_job[t.job] = agg = Resource()
-                agg.add(t.resreq)
-            for juid, agg in by_job.items():
+                group = by_job.get(t.job)
+                if group is None:
+                    by_job[t.job] = [t]
+                else:
+                    group.append(t)
+            for juid, group in by_job.items():
+                agg = Resource.sum_of(t.resreq for t in group)
                 attr = self.job_attrs.get(juid)
                 if attr is None:
                     continue

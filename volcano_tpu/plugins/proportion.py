@@ -227,20 +227,26 @@ class ProportionPlugin(Plugin):
         def on_allocate_batch(tasks):
             """Additive form: one aggregate add + one share recompute per
             queue (share depends only on the allocated total)."""
-            by_queue: Dict[str, Resource] = {}
+            by_queue: Dict[str, list] = {}
+            last_job = None  # statements fire per job: one lookup suffices
+            queue = None
             for t in tasks:
-                job = ssn.jobs.get(t.job)
-                if job is None:
+                if t.job != last_job:
+                    job = ssn.jobs.get(t.job)
+                    queue = job.queue if job is not None else None
+                    last_job = t.job
+                if queue is None:
                     continue
-                agg = by_queue.get(job.queue)
-                if agg is None:
-                    by_queue[job.queue] = agg = Resource()
-                agg.add(t.resreq)
-            for qname, agg in by_queue.items():
+                group = by_queue.get(queue)
+                if group is None:
+                    by_queue[queue] = [t]
+                else:
+                    group.append(t)
+            for qname, group in by_queue.items():
                 attr = self.queue_opts.get(qname)
                 if attr is None:
                     continue
-                attr.allocated.add(agg)
+                attr.allocated.add(Resource.sum_of(t.resreq for t in group))
                 self._update_share(attr)
 
         ssn.add_event_handler(EventHandler(
