@@ -25,6 +25,8 @@ class BackfillAction(Action):
         from ..plugins.predicates import PredicateError
 
         for job in ssn.jobs.values():
+            if TaskStatus.PENDING not in job.task_status_index:
+                continue  # no pending tasks -> nothing to backfill
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
             vr = ssn.job_valid(job)

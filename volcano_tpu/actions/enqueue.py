@@ -48,11 +48,11 @@ class EnqueueAction(Action):
                 jobs_map.setdefault(
                     job.queue, job_queue_factory()).push(job)
 
-        total, used = Resource(), Resource()
+        used = Resource()
         for node in ssn.nodes.values():
-            total.add(node.allocatable)
             used.add(node.used)
-        idle = total.clone().multi(self._overcommit_factor(ssn))
+        idle = ssn.total_allocatable().clone().multi(
+            self._overcommit_factor(ssn))
         try:
             idle.sub(used)
         except ValueError:

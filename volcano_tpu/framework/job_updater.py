@@ -32,20 +32,8 @@ def _shared_pool() -> ThreadPoolExecutor:
     return _POOL
 
 
-def _conditions_equal(c1, c2) -> bool:
-    if len(c1) != len(c2):
-        return False
-    for a, b in zip(c1, c2):
-        # transition_id/time changes alone don't warrant an update
-        if (a.type, a.status, a.reason, a.message) != (b.type, b.status,
-                                                       b.reason, b.message):
-            return False
-    return True
-
-
-def _status_equal(s1, s2) -> bool:
-    return (s1.phase == s2.phase and s1.running == s2.running
-            and s1.succeeded == s2.succeeded and s1.failed == s2.failed)
+# status comparisons use PodGroupStatus.fingerprint() tuples: equal
+# fingerprints = no significant change (transition_id/time excluded)
 
 
 class JobUpdater:
@@ -87,7 +75,7 @@ class JobUpdater:
             return True
         old = ssn.pod_group_status.get(job.uid)
         if (old is None or job.pod_group is None
-                or old.phase != job.pod_group.status.phase):
+                or old[0] != job.pod_group.status.phase):
             return True
         return not job.ready()
 
@@ -96,9 +84,7 @@ class JobUpdater:
             return
         new = job_status(self.ssn, job)
         old = self.ssn.pod_group_status.get(job.uid)
-        update_pg = old is None or not (
-            _status_equal(old, new)
-            and _conditions_equal(old.conditions, new.conditions))
+        update_pg = old is None or old != new.fingerprint()
         try:
             self.ssn.cache.update_job_status(job, update_pg)
         except Exception:

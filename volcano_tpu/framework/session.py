@@ -18,8 +18,8 @@ import uuid
 from typing import Callable, Dict, List, Optional
 
 from ..api import (
-    ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo, TaskStatus,
-    allocated_status,
+    ClusterInfo, JobInfo, NodeInfo, QueueInfo, Resource, TaskInfo,
+    TaskStatus, allocated_status,
 )
 from ..models import PodGroupPhase
 from .event import Event, EventHandler
@@ -71,24 +71,16 @@ class Session:
         self.configurations = []  # per-action args
         self.plugins = {}        # name -> Plugin instance
 
-        # status of every PodGroup at session open; the job updater diffs
-        # end-of-session status against this to decide writes
-        # (job_updater.go:95-100 ssn.podGroupStatus). Statuses are flat
-        # dataclasses (conditions are flat too), so a shallow per-field
-        # copy replaces deepcopy — which alone cost ~80 ms/cycle at 1k jobs
-        import copy
-
-        from ..models import PodGroupStatus
+        # status fingerprint of every PodGroup at session open; the job
+        # updater diffs end-of-session status against this to decide writes
+        # (job_updater.go:95-100 ssn.podGroupStatus). A significance tuple
+        # replaces the earlier per-field status copy: same diff answer,
+        # ~3x cheaper at 1k jobs/cycle (close_session's floor)
         self.pod_group_status = {
-            uid: PodGroupStatus(
-                phase=job.pod_group.status.phase,
-                conditions=[copy.copy(c)
-                            for c in job.pod_group.status.conditions],
-                running=job.pod_group.status.running,
-                succeeded=job.pod_group.status.succeeded,
-                failed=job.pod_group.status.failed)
+            uid: job.pod_group.status.fingerprint()
             for uid, job in self.jobs.items() if job.pod_group is not None
         }
+        self._total_allocatable: Optional[Resource] = None
         # jobs whose podgroup conditions changed significantly this
         # session (update_pod_group_condition); one of the job updater's
         # dirty signals
@@ -376,6 +368,18 @@ class Session:
     # state mutation (session.go:214-378)
     # ------------------------------------------------------------------
 
+    def total_allocatable(self) -> Resource:
+        """Cluster-wide allocatable, summed once per session — drf and
+        proportion each walked all nodes for the same total, which at 2k
+        nodes was a measurable slice of the steady-state cycle. Callers
+        must not mutate the returned Resource (clone first)."""
+        t = self._total_allocatable
+        if t is None:
+            t = Resource.sum_of(
+                n.allocatable for n in self.nodes.values())
+            self._total_allocatable = t
+        return t
+
     def statement(self, defer_events: bool = False):
         from .statement import Statement
         return Statement(self, defer_events=defer_events)
@@ -460,10 +464,11 @@ class Session:
         for i, c in enumerate(conds):
             if c.type == cond.type:
                 # only a significant change dirties the job for the
-                # updater — same significance rule as its
-                # _conditions_equal (transition_id/time don't count), so
-                # gang's steady per-cycle re-post of an identical
-                # Scheduled condition doesn't force 1k no-op recomputes
+                # updater — same significance rule as
+                # PodGroupStatus.fingerprint() (transition_id/time don't
+                # count), so gang's steady per-cycle re-post of an
+                # identical Scheduled condition doesn't force 1k no-op
+                # recomputes
                 if (c.status, c.reason, c.message) != (
                         cond.status, cond.reason, cond.message):
                     self._conditions_touched.add(job.uid)

@@ -55,6 +55,15 @@ class PodGroupStatus:
     succeeded: int = 0
     failed: int = 0
 
+    def fingerprint(self) -> tuple:
+        """Significance fingerprint: two statuses with equal fingerprints
+        need no write (transition_id/time deliberately excluded, matching
+        the job updater's diff rule). Cheap enough to take for every job at
+        session open, unlike a full status copy."""
+        return (self.phase, self.running, self.succeeded, self.failed,
+                tuple((c.type, c.status, c.reason, c.message)
+                      for c in self.conditions))
+
 
 @dataclass
 class PodGroup:

@@ -220,8 +220,7 @@ class DRFPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         from ..api import allocated_status
 
-        for n in ssn.nodes.values():
-            self.total_resource.add(n.allocatable)
+        self.total_resource = ssn.total_allocatable().clone()
 
         # feed the solver: per-round dominant-share job ordering runs as
         # on-device reductions (SURVEY §7 stage 4); allocate fills the

@@ -57,8 +57,7 @@ class ProportionPlugin(Plugin):
         metrics.queue_share.set(res, {"queue_name": attr.name})
 
     def on_session_open(self, ssn) -> None:
-        for n in ssn.nodes.values():
-            self.total_resource.add(n.allocatable)
+        self.total_resource = ssn.total_allocatable().clone()
 
         for job in ssn.jobs.values():
             if job.queue not in self.queue_opts:
