@@ -1,0 +1,95 @@
+"""Profile the steady-state cycle (10k running pods, 100-pod waves) on CPU.
+
+Scratch tool for the round-4 host-path work; not part of the suite.
+Run: JAX_PLATFORMS=cpu python profile_steady.py [--cprofile]
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "tests"))
+
+import numpy as np
+
+from helpers import build_node, build_pod, build_pod_group, build_queue
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.models import PodGroupPhase
+from volcano_tpu.scheduler import Scheduler
+
+n_nodes, n_jobs, tpj = 2000, 1000, 10
+
+
+def make_wave(store, k):
+    pg = build_pod_group(f"j{k}", "bench", min_member=tpj, queue=f"q{k % 3}")
+    pg.status.phase = PodGroupPhase.PENDING
+    store.create("podgroups", pg)
+    for i in range(tpj):
+        store.create("pods", build_pod(
+            "bench", f"j{k}-{i}", "", "Pending",
+            {"cpu": str(1 + k % 3), "memory": f"{1 + k % 4}Gi"}, f"j{k}"))
+
+
+def main():
+    store = ClusterStore()
+    cache = SchedulerCache(store)
+    cache.binder = FakeBinder()
+    cache.evictor = FakeEvictor()
+    cache.run()
+    for i in range(3):
+        store.apply("queues", build_queue(f"q{i}", weight=i + 1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(
+            f"n{i}", {"cpu": "32", "memory": "128Gi"}))
+    for k in range(n_jobs):
+        make_wave(store, k)
+    sched = Scheduler(cache)
+    sched.run_once()  # the burst: now 10k running
+
+    wave = n_jobs
+    for w in range(20):
+        make_wave(store, wave)
+        wave += 1
+        if w % 10 == 9:
+            sched.run_once()
+
+    if "--cprofile" in sys.argv:
+        import cProfile
+        import pstats
+        pr = cProfile.Profile()
+        for s in range(8):
+            for w in range(10):
+                make_wave(store, wave)
+                wave += 1
+            pr.enable()
+            sched.run_once()
+            pr.disable()
+        st = pstats.Stats(pr)
+        st.sort_stats("cumulative").print_stats(50)
+        st.sort_stats("tottime").print_stats(30)
+        print("timing", {k: round(v, 2)
+                         for k, v in sched.last_cycle_timing.items()})
+        return
+
+    lats, host = [], []
+    for s in range(8):
+        for w in range(10):
+            make_wave(store, wave)
+            wave += 1
+        t0 = time.perf_counter()
+        sched.run_once()
+        lats.append((time.perf_counter() - t0) * 1e3)
+        t = sched.last_cycle_timing
+        host.append(t["total_ms"] - t.get("solve_ms", 0.0))
+        sched._maybe_gc()
+    print("steady p50", round(float(np.percentile(lats, 50)), 2),
+          "host p50", round(float(np.percentile(host, 50)), 2))
+    print("timing", {k: round(v, 2)
+                     for k, v in sched.last_cycle_timing.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Subprocess entry for the cross-process HA test: one scheduler process
+attached to a networked ClusterStore, running under leader election.
+
+Usage: python ha_scheduler_proc.py --server HOST:PORT --identity NAME
+The process runs until killed; the test SIGKILLs the leader mid-flight and
+asserts the standby takes over (reference
+cmd/scheduler/app/server.go:85-118: two processes contending on one
+resourcelock at the API server).
+"""
+
+import argparse
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--identity", required=True)
+    ap.add_argument("--period", type=float, default=0.2)
+    args = ap.parse_args()
+
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.client import RemoteClusterStore
+    from volcano_tpu.scheduler import Scheduler
+
+    remote = RemoteClusterStore(args.server)
+    cache = SchedulerCache(remote)
+    sched = Scheduler(cache, period=args.period)
+    print(f"ha-scheduler {args.identity} up", flush=True)
+    stop = threading.Event()
+    # short lease so the test fails over in seconds, not 15s
+    sched.run_with_leader_election(
+        stop, identity=args.identity,
+        lease_duration=2.0, renew_deadline=1.5, retry_period=0.5)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

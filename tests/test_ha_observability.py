@@ -185,3 +185,114 @@ class TestSchedulerHA:
         stop.set()
         t.join(timeout=5)
         assert binder.binds == {"d/p": "n1"}
+
+
+class TestCrossProcessHA:
+    """Two scheduler PROCESSES contending on the lease over the networked
+    store; the leader is SIGKILLed mid-flight and the standby takes over
+    with no double-bind (cmd/scheduler/app/server.go:85-118)."""
+
+    def test_failover_across_processes_no_double_bind(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        import time
+
+        from volcano_tpu.client import StoreServer
+        from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
+        from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+
+        store = ClusterStore()
+
+        # write interceptor: record every bind (pod update that sets
+        # node_name) and flag double-binds / binds while leaderless
+        binds = []
+        violations = []
+
+        def audit(verb, kind, obj):
+            if kind == "pods" and verb == "update" and obj.node_name:
+                prev = store.try_get("pods", obj.name, obj.namespace)
+                if prev is not None and prev.node_name \
+                        and prev.node_name != obj.node_name:
+                    violations.append(
+                        (obj.name, prev.node_name, obj.node_name))
+                binds.append((obj.name, obj.node_name, time.time()))
+            return obj
+
+        store.add_interceptor(audit)
+        server = StoreServer(store).start()
+
+        store.create("nodes", Node(
+            name="n1", allocatable={"cpu": "32", "memory": "64Gi"},
+            capacity={"cpu": "32", "memory": "64Gi"}))
+
+        def submit(idx):
+            pg = PodGroup(name=f"pg{idx}", namespace="d",
+                          spec=PodGroupSpec(min_member=1))
+            store.create("podgroups", pg)
+            store.create("pods", Pod(
+                name=f"p{idx}", namespace="d",
+                annotations={POD_GROUP_ANNOTATION: f"pg{idx}"},
+                containers=[{"requests": {"cpu": "1", "memory": "1Gi"}}]))
+
+        submit(0)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        here = os.path.dirname(os.path.abspath(__file__))
+        procs = {}
+        try:
+            for ident in ("alpha", "beta"):
+                procs[ident] = subprocess.Popen(
+                    [sys.executable, os.path.join(here, "ha_scheduler_proc.py"),
+                     "--server", server.address, "--identity", ident],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True)
+
+            # wait for p0 to be scheduled by whichever process won
+            deadline = time.time() + 120
+            while time.time() < deadline and not binds:
+                time.sleep(0.1)
+            assert binds, "no process ever scheduled p0"
+            leader = store.get("leases", "volcano").holder_identity
+            assert leader in procs
+
+            # kill the leader mid-flight (SIGKILL: no clean release)
+            procs[leader].kill()
+            procs[leader].wait(timeout=10)
+            kill_time = time.time()
+            # the takeover may legally happen at renew_time + duration,
+            # which can precede kill_time: anchor the timing assert there
+            dead_lease = store.get("leases", "volcano")
+            expiry = (dead_lease.renew_time
+                      + dead_lease.lease_duration_seconds)
+
+            # submit more work; the standby must take over after expiry
+            for i in range(1, 4):
+                submit(i)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                scheduled = {b[0] for b in binds}
+                if {"p1", "p2", "p3"} <= scheduled:
+                    break
+                time.sleep(0.1)
+            assert {"p1", "p2", "p3"} <= {b[0] for b in binds}, binds
+
+            # the new leader is the survivor, and nothing double-bound
+            survivor = [i for i in procs if i != leader][0]
+            assert store.get("leases", "volcano").holder_identity == survivor
+            assert violations == []
+            # post-kill binds only came after the lease expired: no write
+            # from the dead leader raced the takeover (0.1s clock slack)
+            post_kill = [b for b in binds if b[2] > kill_time
+                         and b[0] != "p0"]
+            assert post_kill and min(b[2] for b in post_kill) \
+                >= expiry - 0.1
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            server.stop()

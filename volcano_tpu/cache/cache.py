@@ -22,7 +22,7 @@ from ..api.job_info import job_key_of_pod
 from ..models import (
     PodGroup, PodGroupCondition, PodGroupPhase, Queue, QueueSpec,
 )
-from ..client.store import ClusterStore, NotFoundError
+from ..client.store import ClusterStore, ConflictError, NotFoundError
 from ..metrics import metrics
 
 log = logging.getLogger(__name__)
@@ -309,10 +309,15 @@ class SchedulerCache:
 
     def _create_default_queue(self) -> None:
         """Reference creates the default queue CR at startup
-        (cache.go:270-283)."""
+        (cache.go:270-283). Losing the create race is fine — two HA
+        schedulers attaching to one networked store both run this."""
         if self.cluster.try_get("queues", self.default_queue) is None:
-            self.cluster.create(
-                "queues", Queue(name=self.default_queue, spec=QueueSpec(weight=1)))
+            try:
+                self.cluster.create(
+                    "queues",
+                    Queue(name=self.default_queue, spec=QueueSpec(weight=1)))
+            except ConflictError:
+                pass  # a peer created it between our read and write
 
     def run(self) -> None:
         """Subscribe to the store's watch streams (informer start).

@@ -256,6 +256,10 @@ def queue_delete(args, cluster: ClusterStore) -> str:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="vcctl",
                                 description="volcano_tpu command line client")
+    p.add_argument("--server", "-s", default=None, metavar="HOST:PORT",
+                   help="drive a deployed control plane over TCP "
+                        "(standalone --serve-store) instead of an "
+                        "in-process store")
     sub = p.add_subparsers(dest="group")
 
     jobp = sub.add_parser("job")
@@ -323,11 +327,17 @@ ALIASES = {
 
 
 def main(argv: List[str], cluster: Optional[ClusterStore] = None) -> str:
-    if cluster is None:
-        cluster = ClusterStore()
     if argv and argv[0] in ALIASES:
         argv = ALIASES[argv[0]] + argv[1:]
     args = build_parser().parse_args(argv)
+    if cluster is None:
+        if args.server:
+            # the wire path of cmd/cli/vcctl.go:44-49 (kubeconfig -> API
+            # server); here HOST:PORT -> standalone's StoreServer
+            from ..client.remote import RemoteClusterStore
+            cluster = RemoteClusterStore(args.server)
+        else:
+            cluster = ClusterStore()
     if args.group == "version":
         return f"vcctl version {__version__}"
     fn = _DISPATCH.get((args.group, getattr(args, "verb", None)))

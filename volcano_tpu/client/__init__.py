@@ -1,5 +1,7 @@
-"""In-memory cluster store: the API-server/informer seam."""
+"""Cluster store: the API-server/informer seam (in-memory + over TCP)."""
 
+from .remote import RemoteClusterStore  # noqa: F401
+from .server import StoreServer  # noqa: F401
 from .store import (  # noqa: F401
     AdmissionError, ClusterStore, ConflictError, NotFoundError,
 )

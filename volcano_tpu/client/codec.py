@@ -1,0 +1,81 @@
+"""Wire codec for model objects: tagged JSON <-> dataclasses.
+
+The networked ClusterStore (client.server / client.remote) carries the same
+model objects the in-process store holds (volcano_tpu.models dataclasses,
+str-enums nested inside). The codec tags every dataclass node with its
+class name and every enum with its enum class, so the receiving side
+reconstructs real model instances — not dicts — and code like
+``pg.status.phase == PodGroupPhase.RUNNING`` behaves identically on both
+sides of the wire. JSON (not pickle) keeps the protocol inspectable and
+closed over the model registry: a hostile peer can only instantiate
+volcano_tpu.models classes. Reference parity: the k8s API server speaks
+typed JSON for the same objects (vcctl.go talks to it via client-go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict
+
+from .. import models as _models
+
+_T = "__t"   # dataclass tag
+_E = "__e"   # enum tag
+
+
+def _registry() -> Dict[str, type]:
+    reg: Dict[str, type] = {}
+    for name in dir(_models):
+        cls = getattr(_models, name)
+        if isinstance(cls, type) and (
+                dataclasses.is_dataclass(cls)
+                or issubclass(cls, enum.Enum)):
+            reg[cls.__name__] = cls
+    return reg
+
+
+_REGISTRY = _registry()
+
+
+def encode(obj: Any) -> Any:
+    """Model object -> JSON-able structure."""
+    if obj is None or isinstance(obj, (int, float, str, bool)):
+        # str-enums pass the isinstance(str) test: tag them first
+        if isinstance(obj, enum.Enum):
+            return {_E: type(obj).__name__, "v": obj.value}
+        return obj
+    if isinstance(obj, enum.Enum):
+        return {_E: type(obj).__name__, "v": obj.value}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {_T: type(obj).__name__,
+                "f": {f.name: encode(getattr(obj, f.name))
+                      for f in dataclasses.fields(obj)}}
+    if isinstance(obj, dict):
+        return {k: encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    raise TypeError(f"cannot encode {type(obj).__name__} for the wire")
+
+
+def decode(data: Any) -> Any:
+    """JSON structure -> model object (closed over the models registry)."""
+    if isinstance(data, dict):
+        tag = data.get(_T)
+        if tag is not None:
+            cls = _REGISTRY.get(tag)
+            if cls is None or not dataclasses.is_dataclass(cls):
+                raise ValueError(f"unknown model class {tag!r}")
+            fields = {k: decode(v) for k, v in data["f"].items()}
+            known = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in fields.items() if k in known})
+        etag = data.get(_E)
+        if etag is not None:
+            cls = _REGISTRY.get(etag)
+            if cls is None or not issubclass(cls, enum.Enum):
+                raise ValueError(f"unknown enum class {etag!r}")
+            return cls(data["v"])
+        return {k: decode(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [decode(v) for v in data]
+    return data

@@ -1,0 +1,197 @@
+"""RemoteClusterStore: the ClusterStore surface over a StoreServer socket.
+
+Gives every store consumer — vcctl, SchedulerCache, controllers, leader
+election — the same interface against a deployed control plane that the
+in-memory ClusterStore gives them in-process (the reference's client-go
+clientset + informer factory against the API server,
+pkg/scheduler/cache/cache.go:319-402). CRUD is synchronous request/
+response on one mutex-guarded connection; each watch() opens its own
+streaming connection, applies the replay inline (list-then-watch: the
+caller returns with state loaded, exactly like the in-memory store), then
+keeps delivering live events from a reader thread. All listener dispatch
+happens under self.locked(), so a consumer holding the lock (the
+scheduler cache's snapshot) sees a frozen mirror.
+
+Optimistic concurrency travels the wire: the server compares
+resource_version on update and ConflictError/NotFoundError/AdmissionError
+re-raise client-side as the same classes — which is what makes the lease
+CAS of utils.leader_election work across processes.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+from .codec import decode, encode
+from .server import MAGIC, raise_remote, recv_frame, send_frame
+
+
+class RemoteClusterStore:
+    def __init__(self, address: str, connect_timeout: float = 5.0):
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.connect_timeout = connect_timeout
+        self._lock = threading.RLock()   # local mirror/listener lock
+        self._conn_lock = threading.Lock()  # serializes request/response
+        self._conn: Optional[socket.socket] = None
+        self._watch_threads: List[threading.Thread] = []
+        self._watch_socks: List[socket.socket] = []
+        self._closed = False
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.connect_timeout)
+        sock.settimeout(None)
+        sock.sendall(MAGIC)
+        return sock
+
+    def _request(self, payload: dict) -> dict:
+        # Retry rules: a failed SEND is always safe to retry (the server
+        # only acts on complete frames, and a broken connection can never
+        # complete a partial one). A failure AFTER the send is ambiguous —
+        # the server may have applied the op — so only idempotent reads
+        # retry there; a mutating op surfaces the error to its caller
+        # rather than risk double-apply.
+        idempotent = payload.get("op") in ("get", "list", "ping")
+        with self._conn_lock:
+            for attempt in (0, 1):
+                if self._conn is None:
+                    self._conn = self._connect()
+                sent = False
+                try:
+                    send_frame(self._conn, payload)
+                    sent = True
+                    resp = recv_frame(self._conn)
+                    break
+                except (ConnectionError, OSError):
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    self._conn = None
+                    if attempt or (sent and not idempotent):
+                        raise
+        if not resp.get("ok"):
+            raise_remote(resp)
+        return resp
+
+    def close(self) -> None:
+        self._closed = True
+        with self._conn_lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+        for sock in self._watch_socks:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._watch_socks = []
+
+    # -- ClusterStore surface ----------------------------------------------
+
+    def locked(self):
+        return self._lock
+
+    def create(self, kind: str, obj):
+        return decode(self._request(
+            {"op": "create", "kind": kind, "obj": encode(obj)})["obj"])
+
+    def update(self, kind: str, obj):
+        return decode(self._request(
+            {"op": "update", "kind": kind, "obj": encode(obj)})["obj"])
+
+    def apply(self, kind: str, obj):
+        return decode(self._request(
+            {"op": "apply", "kind": kind, "obj": encode(obj)})["obj"])
+
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None):
+        return decode(self._request(
+            {"op": "delete", "kind": kind, "name": name,
+             "namespace": namespace})["obj"])
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None):
+        return decode(self._request(
+            {"op": "get", "kind": kind, "name": name,
+             "namespace": namespace})["obj"])
+
+    def try_get(self, kind: str, name: str, namespace: Optional[str] = None):
+        from .store import NotFoundError
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             name_glob: Optional[str] = None) -> List[Any]:
+        resp = self._request(
+            {"op": "list", "kind": kind, "namespace": namespace,
+             "label_selector": label_selector, "name_glob": name_glob})
+        return [decode(o) for o in resp["objs"]]
+
+    def ping(self) -> bool:
+        return bool(self._request({"op": "ping"}).get("ok"))
+
+    def add_interceptor(self, fn) -> None:
+        raise NotImplementedError(
+            "admission interceptors run in the process that OWNS the "
+            "store (standalone --serve-store starts the webhook chain "
+            "there); a remote client cannot install them")
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str, listener, replay: bool = True) -> None:
+        """Subscribe over a dedicated streaming connection. The replay is
+        applied inline before returning (list-then-watch, same synchronous
+        contract as the in-memory store); live events are then delivered
+        from a daemon reader thread under self.locked()."""
+        sock = self._connect()
+        self._watch_socks.append(sock)
+        send_frame(sock, {"op": "watch", "kinds": [kind], "replay": replay})
+        while True:
+            msg = recv_frame(sock)
+            stream = msg.get("stream")
+            if stream == "synced":
+                break
+            if stream == "event":
+                self._deliver(listener, msg)
+
+        def reader():
+            try:
+                while True:
+                    msg = recv_frame(sock)
+                    if msg.get("stream") != "event":
+                        continue  # heartbeat
+                    with self._lock:
+                        self._deliver(listener, msg)
+            except (ConnectionError, OSError, ValueError):
+                pass  # server went away; consumers resync on reconnect
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=reader, daemon=True,
+                             name=f"store-watch-{kind}")
+        t.start()
+        self._watch_threads.append(t)
+
+    @staticmethod
+    def _deliver(listener, msg: dict) -> None:
+        old = msg.get("old")
+        listener(msg["event"], decode(msg["obj"]),
+                 decode(old) if old is not None else None)

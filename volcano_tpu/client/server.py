@@ -1,0 +1,203 @@
+"""StoreServer: the ClusterStore served over TCP.
+
+The reference's control-plane components are separate processes meeting at
+the Kubernetes API server (cmd/cli/vcctl.go:44-49 CRUDs from anywhere;
+pkg/scheduler/cache/cache.go:319-402 watches ten informer streams). This
+module is the TPU build's API-server seam as an actual server: a
+length-prefixed JSON protocol exposing create/update/apply/delete/get/
+list/watch on one authoritative in-process ClusterStore, so `vcctl
+--server`, remote scheduler caches and HA standbys can drive a deployed
+control plane over the wire.
+
+Protocol: 4-byte magic "VCS1", then frames of <u32 length><JSON bytes>.
+Request ops mirror the ClusterStore surface; errors return their class
+name and re-raise as the same class client-side. A `watch` request turns
+the connection into an event stream: replayed adds, then {"stream":
+"synced"}, then live events as they commit. Frame size is capped so a
+corrupt or hostile peer cannot drive unbounded allocation (same rule as
+the solver sidecar, parallel/sidecar.py:35-53).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+from .codec import decode, encode
+from .store import AdmissionError, ClusterStore, ConflictError, NotFoundError
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"VCS1"
+MAX_FRAME_BYTES = 64 << 20  # a 10k-pod wave of Jobs is ~10 MB of JSON
+
+_ERRORS = {
+    "ConflictError": ConflictError,
+    "NotFoundError": NotFoundError,
+    "AdmissionError": AdmissionError,
+}
+
+
+def send_frame(sock: socket.socket, payload: dict) -> None:
+    raw = json.dumps(payload).encode()
+    if len(raw) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(raw)} bytes exceeds cap")
+    sock.sendall(struct.pack("<I", len(raw)) + raw)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> dict:
+    (length,) = struct.unpack("<I", recv_exact(sock, 4))
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame length {length} exceeds cap")
+    return json.loads(recv_exact(sock, length))
+
+
+def raise_remote(resp: dict) -> None:
+    """Re-raise a {"ok": false} response as its original error class."""
+    cls = _ERRORS.get(resp.get("error"), RuntimeError)
+    raise cls(resp.get("message", "remote store error"))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # noqa: D102 — socketserver contract
+        sock = self.request
+        store: ClusterStore = self.server.store  # type: ignore[attr-defined]
+        try:
+            if recv_exact(sock, 4) != MAGIC:
+                return
+            while True:
+                req = recv_frame(sock)
+                op = req.get("op")
+                if op == "watch":
+                    self._serve_watch(sock, store, req)
+                    return  # watch connections never go back to req/resp
+                try:
+                    resp = self._dispatch(store, op, req)
+                except (ConflictError, NotFoundError, AdmissionError) as e:
+                    resp = {"ok": False, "error": type(e).__name__,
+                            "message": str(e)}
+                except Exception as e:  # noqa: BLE001 — report, keep serving
+                    log.exception("store op %s failed", op)
+                    resp = {"ok": False, "error": "RuntimeError",
+                            "message": str(e)}
+                try:
+                    send_frame(sock, resp)
+                except ValueError as e:
+                    # oversize response (giant list): the size check fires
+                    # before any bytes hit the socket, so the connection
+                    # is still clean — report instead of dying silently
+                    send_frame(sock, {"ok": False, "error": "RuntimeError",
+                                      "message": str(e)})
+        except (ConnectionError, OSError):
+            pass  # client went away
+
+    @staticmethod
+    def _dispatch(store: ClusterStore, op: str, req: dict) -> dict:
+        kind = req.get("kind")
+        if op in ("create", "update", "apply"):
+            obj = getattr(store, op)(kind, decode(req["obj"]))
+            return {"ok": True, "obj": encode(obj)}
+        if op == "delete":
+            obj = store.delete(kind, req["name"], req.get("namespace"))
+            return {"ok": True, "obj": encode(obj)}
+        if op == "get":
+            obj = store.get(kind, req["name"], req.get("namespace"))
+            return {"ok": True, "obj": encode(obj)}
+        if op == "list":
+            objs = store.list(kind, req.get("namespace"),
+                              req.get("label_selector"),
+                              req.get("name_glob"))
+            return {"ok": True, "objs": [encode(o) for o in objs]}
+        if op == "ping":
+            return {"ok": True}
+        raise RuntimeError(f"unknown op {op!r}")
+
+    def _serve_watch(self, sock: socket.socket, store: ClusterStore,
+                     req: dict) -> None:
+        """Stream events for the requested kinds until the peer leaves.
+
+        The listener enqueues under the store lock and a writer loop
+        drains, so a slow or stuck watcher never blocks store writes
+        (client-go's watch buffers give the reference the same
+        isolation)."""
+        kinds = req.get("kinds") or [req.get("kind")]
+        replay = bool(req.get("replay", True))
+        events: "queue.Queue" = queue.Queue()
+
+        def listener_for(kind):
+            def listener(event, obj, old):
+                events.put({"stream": "event", "kind": kind,
+                            "event": event, "obj": encode(obj),
+                            "old": encode(old) if old is not None else None})
+            return listener
+
+        listeners = [(kind, listener_for(kind)) for kind in kinds]
+        # subscribe with replay: the replayed adds land in the queue
+        # before any post-subscribe event (watch() delivers under the
+        # store lock), preserving list-then-watch ordering
+        for kind, listener in listeners:
+            store.watch(kind, listener, replay=replay)
+        events.put({"stream": "synced"})
+        try:
+            while True:
+                try:
+                    payload = events.get(timeout=10.0)
+                except queue.Empty:
+                    # heartbeat: an idle cluster would otherwise never
+                    # touch the socket, so a dead peer's listener would
+                    # stay subscribed forever
+                    payload = {"stream": "heartbeat"}
+                send_frame(sock, payload)
+        except (ConnectionError, OSError, ValueError):
+            pass  # peer went away
+        finally:
+            for kind, listener in listeners:
+                store.unwatch(kind, listener)
+
+
+class StoreServer:
+    """Serve a ClusterStore on host:port (TCP, daemon threads)."""
+
+    def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.store = store  # type: ignore[attr-defined]
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "StoreServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="store-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -88,6 +88,16 @@ class ClusterStore:
                 for obj in list(self._buckets[kind].values()):
                     listener("add", obj, None)
 
+    def unwatch(self, kind: str, listener: Listener) -> None:
+        """Drop a subscription (a disconnected remote watcher must not keep
+        receiving — and leaking — events; the in-process consumers never
+        unsubscribe)."""
+        with self._lock:
+            try:
+                self._listeners[kind].remove(listener)
+            except ValueError:
+                pass
+
     def _notify(self, kind: str, event: str, obj, old=None) -> None:
         for fn in list(self._listeners[kind]):
             fn(event, obj, old)

@@ -10,7 +10,7 @@ from .batch import (  # noqa: F401
 )
 from .bus import Action, Command, Event  # noqa: F401
 from .core import (  # noqa: F401
-    ConfigMap, NetworkPolicy, Node, PersistentVolumeClaim, Pod,
+    ConfigMap, Lease, NetworkPolicy, Node, PersistentVolumeClaim, Pod,
     PriorityClass, ResourceQuota, Secret, Service, new_uid,
 )
 from .scheduling import (  # noqa: F401

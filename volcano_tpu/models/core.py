@@ -131,3 +131,24 @@ class NetworkPolicy:
     namespace: str = "default"
     spec: Dict[str, Any] = field(default_factory=dict)
     owner_references: List[Dict[str, Any]] = field(default_factory=list)
+
+
+#: default lease duration (reference cmd/scheduler/app/server.go:50); the
+#: single source of truth — utils.leader_election imports it
+LEASE_DURATION = 15.0
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease subset (cluster-scoped here); the
+    leader-election lock record (utils.leader_election). Lives with the
+    models so the wire codec can carry it between HA processes."""
+
+    name: str
+    holder_identity: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_seconds: float = LEASE_DURATION
+    lease_transitions: int = 0
+    resource_version: int = 0
+    uid: str = field(default_factory=lambda: new_uid("lease"))

@@ -127,9 +127,14 @@ class Scheduler:
         metrics.e2e_scheduling_latency.observe(total)
 
     def run_with_leader_election(self, stop, lock_name: str = "volcano",
-                                 identity: Optional[str] = None) -> None:
+                                 identity: Optional[str] = None,
+                                 lease_duration: Optional[float] = None,
+                                 renew_deadline: Optional[float] = None,
+                                 retry_period: Optional[float] = None) -> None:
         """HA mode (cmd/scheduler/app/server.go:85-145): only the lease
         holder schedules; standbys poll the lease and take over on expiry.
+        The lease timings are overridable (tests shrink them to fail over
+        in seconds; the defaults match the reference's 15/10/5).
 
         Lease renewal runs on its own thread at the elector's retry period
         (like client-go's renew loop), so a long scheduling cycle or a long
@@ -137,9 +142,15 @@ class Scheduler:
         import threading
 
         from .utils import LeaderElector, LeaseLock
+        from .utils.leader_election import (
+            LEASE_DURATION, RENEW_DEADLINE, RETRY_PERIOD,
+        )
 
         elector = LeaderElector(
-            LeaseLock(self.cache.cluster, lock_name), identity=identity)
+            LeaseLock(self.cache.cluster, lock_name), identity=identity,
+            lease_duration=lease_duration or LEASE_DURATION,
+            renew_deadline=renew_deadline or RENEW_DEADLINE,
+            retry_period=retry_period or RETRY_PERIOD)
         self._elector = elector
         renewer = threading.Thread(target=elector.run, args=(stop,),
                                    name="leader-elector", daemon=True)

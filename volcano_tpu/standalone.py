@@ -34,7 +34,8 @@ class Standalone:
                  period: float = 1.0, serve_webhooks_tls: bool = False,
                  sidecar_path: Optional[str] = None,
                  metrics_port: int = 0,
-                 async_effectors: bool = True):
+                 async_effectors: bool = True,
+                 serve_store: Optional[str] = None):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -43,7 +44,18 @@ class Standalone:
         from .webhooks import start_webhooks
 
         self.store = ClusterStore()
+        # admission interceptors must be installed BEFORE the store starts
+        # accepting remote writes, or an early vcctl create slips past the
+        # webhook chain
         start_webhooks(self.store)
+        self.store_server = None
+        if serve_store:
+            # the API-server seam as an actual server: vcctl --server and
+            # remote scheduler caches drive this store over TCP
+            from .client import StoreServer
+            host, _, port = serve_store.rpartition(":")
+            self.store_server = StoreServer(
+                self.store, host or "127.0.0.1", int(port)).start()
         self.webhook_server = None
         if serve_webhooks_tls:
             from .webhooks import serve_webhooks
@@ -83,6 +95,8 @@ class Standalone:
     def stop(self) -> None:
         self._stop.set()
         self.metrics_server.stop()
+        if self.store_server is not None:
+            self.store_server.stop()
         if self.webhook_server is not None:
             self.webhook_server.shutdown()
 
@@ -103,6 +117,10 @@ def main(argv=None) -> int:
     ap.add_argument("--sidecar", help="solver sidecar socket path")
     ap.add_argument("--metrics-port", type=int, default=8080)
     ap.add_argument("--jobs-dir", help="apply every .yaml job in this dir")
+    ap.add_argument("--serve-store", metavar="[HOST:]PORT",
+                    help="serve the cluster store over TCP so vcctl "
+                         "--server and remote components can drive this "
+                         "control plane")
     args = ap.parse_args(argv)
 
     conf = None
@@ -112,7 +130,8 @@ def main(argv=None) -> int:
     sa = Standalone(scheduler_conf=conf, period=args.period,
                     serve_webhooks_tls=args.serve_webhooks,
                     sidecar_path=args.sidecar,
-                    metrics_port=args.metrics_port)
+                    metrics_port=args.metrics_port,
+                    serve_store=args.serve_store)
     if args.jobs_dir:
         import glob
         import os
@@ -120,7 +139,9 @@ def main(argv=None) -> int:
             with open(path) as f:
                 sa.apply_job_yaml(f.read())
     print(f"volcano-tpu standalone up; metrics on "
-          f":{sa.metrics_server.port}", flush=True)
+          f":{sa.metrics_server.port}"
+          + (f"; store on {sa.store_server.address}"
+             if sa.store_server else ""), flush=True)
     try:
         sa.run()
     except KeyboardInterrupt:

@@ -17,28 +17,17 @@ import copy
 import threading
 import time
 import uuid
-from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from volcano_tpu.client.store import ConflictError, NotFoundError
+# Lease lives with the models so the wire codec can carry it between
+# processes (cross-process HA contends on the lease over the networked
+# store; codec.py only reconstructs volcano_tpu.models classes)
+from volcano_tpu.models import Lease
+from volcano_tpu.models.core import LEASE_DURATION  # noqa: F401 — re-export
 
-LEASE_DURATION = 15.0   # server.go:50
 RENEW_DEADLINE = 10.0   # server.go:51
 RETRY_PERIOD = 5.0      # server.go:52
-
-
-@dataclass
-class Lease:
-    """coordination.k8s.io/v1 Lease subset (cluster-scoped here)."""
-
-    name: str
-    holder_identity: str = ""
-    acquire_time: float = 0.0
-    renew_time: float = 0.0
-    lease_duration_seconds: float = LEASE_DURATION
-    lease_transitions: int = 0
-    resource_version: int = 0
-    uid: str = field(default_factory=lambda: str(uuid.uuid4()))
 
 
 class LeaseLock:
