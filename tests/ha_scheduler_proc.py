@@ -29,7 +29,10 @@ def main() -> int:
     from volcano_tpu.client import RemoteClusterStore
     from volcano_tpu.scheduler import Scheduler
 
-    remote = RemoteClusterStore(args.server)
+    # crash-only on a broken watch stream: the mirror can't resync in
+    # place, so exit and let the supervisor (or the HA standby) cover
+    remote = RemoteClusterStore(
+        args.server, on_watch_failure=lambda: os._exit(3))
     cache = SchedulerCache(remote)
     sched = Scheduler(cache, period=args.period)
     print(f"ha-scheduler {args.identity} up", flush=True)

@@ -55,6 +55,16 @@ class TestCodec:
         assert isinstance(out, Job)
         assert out.status.state.phase is JobPhase.RUNNING
 
+    def test_secret_bytes_roundtrip(self):
+        from volcano_tpu.models import Secret
+        sec = Secret(name="s1", namespace="d",
+                     data={"id_rsa": b"\x00private\xff",
+                           "config": b"StrictHostKeyChecking no\n"})
+        out = decode(encode(sec))
+        assert isinstance(out, Secret)
+        assert out.data["id_rsa"] == b"\x00private\xff"
+        assert out.data["config"] == b"StrictHostKeyChecking no\n"
+
     def test_decode_rejects_unknown_class(self):
         with pytest.raises(ValueError):
             decode({"__t": "os.system", "f": {}})
@@ -191,6 +201,29 @@ class TestRemoteScheduling:
         assert all(p.node_name == "n1"
                    for p in store.list("pods", namespace="ns1"))
 
+        # a SECOND wave after the first bind's informer echo: the echoed
+        # update's stale `old` must not corrupt the mirror (the cache
+        # deletes by its own stored task, not the event copy)
+        pg2 = build_pod_group("pg2", "ns1", min_member=2)
+        store.create("podgroups", pg2)
+        for i in range(2):
+            store.create("pods", build_pod("ns1", f"q{i}", "", "Pending",
+                                           {"cpu": "1", "memory": "1Gi"},
+                                           "pg2"))
+        time.sleep(0.3)  # let the watch deliver the new wave
+        sched.run_once()
+        cache.wait_for_effects()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            pods = [p for p in store.list("pods", namespace="ns1")
+                    if p.name.startswith("q")]
+            if len(pods) == 2 and all(p.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        assert all(p.node_name == "n1" for p in pods), [
+            (p.name, p.node_name) for p in pods]
+        assert not remote.watch_failed
+
 
 class TestVcctlOverTcpE2E:
     def test_submit_via_tcp_to_separate_process(self, tmp_path):
@@ -291,3 +324,88 @@ def _connect_with_retry(address: str, proc,
             last = e
             time.sleep(0.5)
     raise AssertionError(f"could not reach standalone store: {last}")
+
+
+class TestStoreAuth:
+    """Shared-token auth on the store server: wrong/missing token is
+    refused before any op can touch the store; the right token works
+    end to end (the manifest requires this for non-loopback binds)."""
+
+    def test_token_required_and_accepted(self):
+        store = ClusterStore()
+        server = StoreServer(store, token="s3cret").start()
+        try:
+            good = RemoteClusterStore(server.address, token="s3cret")
+            good.create("nodes", build_node("n1", {"cpu": "1"}))
+            assert store.get("nodes", "n1").name == "n1"
+
+            for bad_token in ("", "wrong"):
+                bad = RemoteClusterStore(server.address, token=bad_token)
+                with pytest.raises((RuntimeError, ConnectionError,
+                                    OSError)):
+                    bad.list("nodes")
+            assert len(store.list("nodes")) == 1
+        finally:
+            server.stop()
+
+    def test_tokenless_server_ignores_auth(self):
+        store = ClusterStore()
+        server = StoreServer(store).start()
+        try:
+            remote = RemoteClusterStore(server.address, token="whatever")
+            assert remote.ping()
+        finally:
+            server.stop()
+
+
+class TestWatchFailureCallback:
+    def test_server_death_triggers_callback_once(self):
+        store = ClusterStore()
+        server = StoreServer(store).start()
+        fired = []
+        remote = RemoteClusterStore(server.address, token="",
+                                    on_watch_failure=lambda:
+                                    fired.append(1))
+        remote.watch("nodes", lambda *a: None)
+        remote.watch("pods", lambda *a: None)
+        server.stop()  # kills the streams
+        deadline = time.time() + 10
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.3)  # the second stream's failure must not re-fire
+        assert fired == [1]
+        assert remote.watch_failed
+
+    def test_clean_close_does_not_fire(self):
+        store = ClusterStore()
+        server = StoreServer(store).start()
+        fired = []
+        remote = RemoteClusterStore(server.address, token="",
+                                    on_watch_failure=lambda:
+                                    fired.append(1))
+        remote.watch("nodes", lambda *a: None)
+        remote.close()
+        time.sleep(0.3)
+        assert fired == [] and not remote.watch_failed
+        server.stop()
+
+    def test_unknown_watch_kind_refused_without_leak(self):
+        store = ClusterStore()
+        server = StoreServer(store).start()
+        try:
+            import socket as socket_mod
+            from volcano_tpu.client.server import (
+                MAGIC, recv_frame, send_frame,
+            )
+            sock = socket_mod.create_connection(
+                (server.host, server.port), timeout=5)
+            sock.sendall(MAGIC)
+            send_frame(sock, {"op": "watch",
+                              "kinds": ["pods", "bogus"]})
+            resp = recv_frame(sock)
+            assert resp["ok"] is False and "bogus" in resp["message"]
+            sock.close()
+            # nothing stayed subscribed
+            assert not store._listeners["pods"]
+        finally:
+            server.stop()

@@ -152,9 +152,8 @@ class TestShardedEvict:
         from volcano_tpu.api import TaskStatus
         from volcano_tpu.api.types import POD_GROUP_ANNOTATION
         from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
-        from volcano_tpu.ops import bucket
         from volcano_tpu.ops.evict import (
-            decode_evict_compact, solve_evict_uniform,
+            decode_evict_compact, pack_victim_arrays, solve_evict_uniform,
         )
         from volcano_tpu.parallel import solve_evict_uniform_sharded
 
@@ -192,30 +191,8 @@ class TestShardedEvict:
 
         arr = flatten_snapshot({hi.uid: hi}, nodes, claimers)
         params = params_dict(arr, least_req_weight=1.0)
-        node_index = {n.name: i for i, n in enumerate(arr.nodes_list)}
-        ordered = sorted(victims, key=lambda t: node_index[t.node_name])
-        V = bucket(len(ordered))
-        J = arr.job_min.shape[0]
-        v_req = np.zeros((V, arr.R), np.float32)
-        v_node = np.zeros(V, np.int32)
-        v_valid = np.zeros(V, bool)
-        for i, t in enumerate(ordered):
-            v_req[i] = t.resreq.to_vector(arr.vocab)
-            v_node[i] = node_index[t.node_name]
-            v_valid[i] = True
-        elig = np.zeros((J, V), bool)
-        elig[0, :len(ordered)] = True
-        need = np.zeros(J, np.int32)
-        need[0] = n_claim
-        job_req = np.zeros((J, arr.R), np.float32)
-        job_req[0] = arr.task_init_req[0]
-        job_acct = np.zeros((J, arr.R), np.float32)
-        job_acct[0] = arr.task_req[0]
-        job_count = np.zeros(J, np.int32)
-        job_count[0] = n_claim
-        varrays = {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
-                   "elig": elig, "job_need": need, "job_req": job_req,
-                   "job_acct": job_acct, "job_count": job_count}
+        varrays = pack_victim_arrays(arr, victims, n_claim)
+        v_req, v_node = varrays["v_req"], varrays["v_node"]
 
         assert arr.N % 8 == 0, arr.N
         r1 = solve_evict_uniform(arr.device_dict(), varrays, params)

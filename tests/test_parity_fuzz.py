@@ -382,3 +382,94 @@ def test_strict_mode_matches_strict_oracle():
         thr = a["thresholds"]
         assert (qalloc <= deserved + thr[None, :] + 1e-3).all(), \
             f"strict case {case}: queue exceeded strict deserved"
+
+
+class TestMultiCycleStarvation:
+    """VERDICT r3 weak #3: the rounds solver's like-for-like job swaps in
+    one snapshot must not compound into starvation across cycles. Churn
+    model: each cycle, every gang the solver completed runs and vacates;
+    the remainder re-contend. Asserts (a) every job completes within the
+    ideal cycle count + 1 slack cycle — a job on the losing side of a
+    swap cannot lose repeatedly; (b) per-cycle completed jobs >= the
+    sequential reference oracle on the identical state (the reference's
+    stable order, allocate.go:124-166, is the structural floor)."""
+
+    def _build(self, job_ids, n_nodes, node_cpu, tpj):
+        from volcano_tpu.api import JobInfo, NodeInfo, TaskInfo
+        from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+        from volcano_tpu.models import Node, Pod, PodGroup, PodGroupSpec
+
+        nodes = {}
+        for i in range(n_nodes):
+            rl = {"cpu": str(node_cpu), "memory": "64Gi", "pods": 110}
+            nodes[f"n{i}"] = NodeInfo(Node(name=f"n{i}", allocatable=rl,
+                                           capacity=dict(rl)))
+        jobs, tasks = {}, []
+        for k in job_ids:
+            pg = PodGroup(name=f"j{k}", namespace="s",
+                          spec=PodGroupSpec(min_member=tpj))
+            job = JobInfo(f"s/j{k}", pg)
+            for i in range(tpj):
+                pod = Pod(name=f"j{k}-{i}", namespace="s",
+                          annotations={POD_GROUP_ANNOTATION: f"j{k}"},
+                          containers=[{"requests": {"cpu": "1",
+                                                    "memory": "1Gi"}}])
+                t = TaskInfo(pod)
+                job.add_task_info(t)
+                tasks.append(t)
+            jobs[job.uid] = job
+        return jobs, nodes, tasks
+
+    def test_all_jobs_complete_within_bound(self):
+        import math
+
+        from volcano_tpu.ops import flatten_snapshot
+
+        n_jobs, tpj, n_nodes, node_cpu = 20, 5, 10, 5
+        # capacity 50 one-cpu slots per cycle vs 100 demanded: 2x
+        # contention, all jobs identical (the pure like-for-like regime)
+        pending = list(range(n_jobs))
+        waits = {}
+        cycle = 0
+        per_cycle = []
+        while pending and cycle < 10:
+            jobs, nodes, tasks = self._build(pending, n_nodes, node_cpu,
+                                             tpj)
+            arr = flatten_snapshot(jobs, nodes, tasks)
+            from volcano_tpu.ops import ScoreParams
+            sp = ScoreParams(least_req_weight=1.0).resolved(arr.R, arr.N)
+            p = {"binpack_weight": np.float32(0.0),
+                 "binpack_res_weights": sp.binpack_res_weights,
+                 "least_req_weight": np.float32(1.0),
+                 "most_req_weight": np.float32(0.0),
+                 "balanced_weight": np.float32(0.0),
+                 "node_static": sp.node_static}
+            d = arr.device_dict()
+            ready_r = np.asarray(
+                solve_allocate(d, p, herd_mode="spread",
+                               score_families=("kube",)).job_ready)
+            ready_s = np.asarray(
+                solve_allocate_sequential(
+                    d, p, score_families=("kube",)).job_ready)
+            done_rounds = int(ready_r[:len(pending)].sum())
+            done_seq = int(ready_s[:len(pending)].sum())
+            # (b) the rounds solver completes at least the oracle's jobs
+            assert done_rounds >= done_seq, (cycle, done_rounds, done_seq)
+            assert done_rounds > 0, "no progress: live-lock"
+            survivors = []
+            for idx, k in enumerate(pending):
+                if ready_r[idx]:
+                    waits[k] = cycle
+                else:
+                    survivors.append(k)
+            per_cycle.append(done_rounds)
+            pending = survivors
+            cycle += 1
+
+        assert not pending, f"starved jobs: {pending} (waits={waits})"
+        # (a) ideal = ceil(jobs / first-cycle throughput); +1 slack cycle
+        ideal = math.ceil(n_jobs / per_cycle[0])
+        max_wait = max(waits.values())
+        assert max_wait <= ideal, (
+            f"job waited {max_wait} cycles (ideal completion "
+            f"{ideal - 1}): starvation. waits={waits}")

@@ -99,3 +99,59 @@ def test_wire_codec_roundtrip():
     assert back.spec.tasks[0].replicas == 3
     assert back.spec.policies[0].action == "RestartJob"
     assert back.spec.queue == "q"
+
+
+class TestMutualTLS:
+    """client_ca_path (wired by installer/volcano-tpu-development.yaml):
+    an uncerted client must be rejected at the TLS layer; a client
+    presenting a cert signed by the CA drives admission normally."""
+
+    def test_uncerted_client_rejected_certed_accepted(self, tmp_path):
+        from volcano_tpu.client import ClusterStore
+        from volcano_tpu.models import Queue, QueueSpec
+        from volcano_tpu.webhooks.server import generate_self_signed_cert
+
+        # a self-signed client cert doubles as its own CA
+        client_cert, client_key = generate_self_signed_cert(
+            str(tmp_path), common_name="admission-client")
+        cluster = ClusterStore()
+        cluster.create("queues", Queue(name="default",
+                                       spec=QueueSpec(weight=1)))
+        srv = serve_webhooks(cluster, client_ca_path=client_cert)
+        srv.start_background()
+        try:
+            host, port = srv.address[:2]
+            review = {"request": {"operation": "CREATE", "object": {
+                "name": "q2", "spec": {"weight": 2}}}}
+            url = f"https://{host}:{port}/queues/validate"
+
+            # no client cert: rejected at the TLS layer. TLS1.3 surfaces
+            # the rejection either at handshake (SSLError) or at first
+            # write (urllib wraps it in URLError) — but never as an HTTP
+            # response: the request must not reach admission
+            import urllib.error
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            req = urllib.request.Request(
+                url, data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises((ssl.SSLError,
+                                urllib.error.URLError)) as ei:
+                urllib.request.urlopen(req, context=ctx, timeout=10)
+            assert not isinstance(ei.value, urllib.error.HTTPError)
+
+            # with the cert: admission answers
+            ctx2 = ssl.create_default_context()
+            ctx2.check_hostname = False
+            ctx2.verify_mode = ssl.CERT_NONE
+            ctx2.load_cert_chain(client_cert, client_key)
+            with urllib.request.urlopen(
+                    urllib.request.Request(
+                        url, data=json.dumps(review).encode(),
+                        headers={"Content-Type": "application/json"}),
+                    context=ctx2, timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body["response"]["allowed"] is True
+        finally:
+            srv.shutdown()

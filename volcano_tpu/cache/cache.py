@@ -431,11 +431,25 @@ class SchedulerCache:
         if job_err or node_err:
             raise KeyError(f"failed to delete task {ti.key}: {job_err} {node_err}")
 
+    def _stored_task(self, ti: TaskInfo) -> Optional[TaskInfo]:
+        """The task as THIS cache knows it. Event objects from a remote
+        store are decoded copies, so an update's ``old`` can lag the
+        cache's own effector writes (cache.bind set node_name before the
+        informer echo arrives); deleting by the stale copy would skip the
+        node removal and the re-add would double-place. In-process the
+        store shares objects, which masked this."""
+        job = self.jobs.get(ti.job)
+        if job is None:
+            return None
+        return job.tasks.get(ti.key)
+
     def update_pod(self, old_pod, new_pod) -> None:
         if new_pod.scheduler_name != self.scheduler_name:
             return
+        old_ti = TaskInfo(old_pod)
+        stored = self._stored_task(old_ti)
         try:
-            self.delete_task(TaskInfo(old_pod))
+            self.delete_task(stored if stored is not None else old_ti)
         except KeyError:
             pass
         self.add_task(TaskInfo(new_pod))
@@ -444,8 +458,9 @@ class SchedulerCache:
         if pod.scheduler_name != self.scheduler_name:
             return
         ti = TaskInfo(pod)
+        stored = self._stored_task(ti)
         try:
-            self.delete_task(ti)
+            self.delete_task(stored if stored is not None else ti)
         except KeyError as e:
             log.warning("delete_pod: %s", e)
         job = self.jobs.get(ti.job)

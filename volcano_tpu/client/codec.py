@@ -14,6 +14,7 @@ typed JSON for the same objects (vcctl.go talks to it via client-go).
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import enum
 from typing import Any, Dict
@@ -22,6 +23,9 @@ from .. import models as _models
 
 _T = "__t"   # dataclass tag
 _E = "__e"   # enum tag
+_B = "__b"   # bytes tag (Secret data values are bytes)
+_D = "__d"   # escape tag: plain dict whose own keys collide with a tag
+_RESERVED = frozenset((_T, _E, _B, _D))
 
 
 def _registry() -> Dict[str, type]:
@@ -40,19 +44,24 @@ _REGISTRY = _registry()
 
 def encode(obj: Any) -> Any:
     """Model object -> JSON-able structure."""
-    if obj is None or isinstance(obj, (int, float, str, bool)):
-        # str-enums pass the isinstance(str) test: tag them first
-        if isinstance(obj, enum.Enum):
-            return {_E: type(obj).__name__, "v": obj.value}
-        return obj
+    # str/int-enums would pass the primitive isinstance test: tag first
     if isinstance(obj, enum.Enum):
         return {_E: type(obj).__name__, "v": obj.value}
+    if obj is None or isinstance(obj, (int, float, str, bool)):
+        return obj
+    if isinstance(obj, bytes):
+        return {_B: base64.b64encode(obj).decode()}
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {_T: type(obj).__name__,
                 "f": {f.name: encode(getattr(obj, f.name))
                       for f in dataclasses.fields(obj)}}
     if isinstance(obj, dict):
-        return {k: encode(v) for k, v in obj.items()}
+        out = {k: encode(v) for k, v in obj.items()}
+        if _RESERVED & out.keys():
+            # a user dict (annotation/label/template) whose own keys
+            # collide with a tag must not be mistaken for a tagged node
+            return {_D: out}
+        return out
     if isinstance(obj, (list, tuple)):
         return [encode(v) for v in obj]
     raise TypeError(f"cannot encode {type(obj).__name__} for the wire")
@@ -75,6 +84,11 @@ def decode(data: Any) -> Any:
             if cls is None or not issubclass(cls, enum.Enum):
                 raise ValueError(f"unknown enum class {etag!r}")
             return cls(data["v"])
+        btag = data.get(_B)
+        if btag is not None:
+            return base64.b64decode(btag)
+        if _D in data:
+            return {k: decode(v) for k, v in data[_D].items()}
         return {k: decode(v) for k, v in data.items()}
     if isinstance(data, list):
         return [decode(v) for v in data]

@@ -20,20 +20,44 @@ CAS of utils.leader_election work across processes.
 
 from __future__ import annotations
 
+import logging
+import os
 import socket
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from .codec import decode, encode
 from .server import MAGIC, raise_remote, recv_frame, send_frame
 
+log = logging.getLogger(__name__)
+
 
 class RemoteClusterStore:
-    def __init__(self, address: str, connect_timeout: float = 5.0):
+    """See module docstring. Two deployment-facing knobs:
+
+    - ``token``: shared-secret auth presented on every connection
+      (defaults to $VOLCANO_STORE_TOKEN so vcctl and operator scripts
+      pick it up without plumbing).
+    - ``on_watch_failure``: called once when a watch stream dies. The
+      cache's event handlers are NOT idempotent (replaying adds would
+      double-count), so a broken stream cannot be transparently resumed;
+      the crash-only answer is to exit and let the supervisor restart
+      with a fresh snapshot (HA standbys cover the gap — client-go's
+      reflector re-list is this build's process restart). The default
+      logs CRITICAL and sets ``watch_failed``; long-running consumers
+      (ha_scheduler_proc) pass an exiting callback."""
+
+    def __init__(self, address: str, connect_timeout: float = 5.0,
+                 token: Optional[str] = None,
+                 on_watch_failure: Optional[Callable[[], None]] = None):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.connect_timeout = connect_timeout
+        self.token = token if token is not None \
+            else os.environ.get("VOLCANO_STORE_TOKEN", "")
+        self.on_watch_failure = on_watch_failure
+        self.watch_failed = False
         self._lock = threading.RLock()   # local mirror/listener lock
         self._conn_lock = threading.Lock()  # serializes request/response
         self._conn: Optional[socket.socket] = None
@@ -48,6 +72,12 @@ class RemoteClusterStore:
                                         timeout=self.connect_timeout)
         sock.settimeout(None)
         sock.sendall(MAGIC)
+        if self.token:
+            send_frame(sock, {"op": "auth", "token": self.token})
+            resp = recv_frame(sock)
+            if not resp.get("ok"):
+                sock.close()
+                raise_remote(resp)
         return sock
 
     def _request(self, payload: dict) -> dict:
@@ -159,15 +189,20 @@ class RemoteClusterStore:
         contract as the in-memory store); live events are then delivered
         from a daemon reader thread under self.locked()."""
         sock = self._connect()
-        self._watch_socks.append(sock)
         send_frame(sock, {"op": "watch", "kinds": [kind], "replay": replay})
         while True:
             msg = recv_frame(sock)
+            if msg.get("ok") is False:
+                # server refused the subscription (e.g. unknown kind):
+                # surface its message, not a dangling ConnectionError
+                sock.close()
+                raise_remote(msg)
             stream = msg.get("stream")
             if stream == "synced":
                 break
             if stream == "event":
                 self._deliver(listener, msg)
+        self._watch_socks.append(sock)
 
         def reader():
             try:
@@ -177,8 +212,13 @@ class RemoteClusterStore:
                         continue  # heartbeat
                     with self._lock:
                         self._deliver(listener, msg)
-            except (ConnectionError, OSError, ValueError):
-                pass  # server went away; consumers resync on reconnect
+            except (ConnectionError, OSError, ValueError) as e:
+                if not self._closed:
+                    self._watch_broke(kind, e)
+            except Exception as e:  # noqa: BLE001 — a listener blew up
+                log.exception("watch listener for %s failed", kind)
+                if not self._closed:
+                    self._watch_broke(kind, e)
             finally:
                 try:
                     sock.close()
@@ -189,6 +229,22 @@ class RemoteClusterStore:
                              name=f"store-watch-{kind}")
         t.start()
         self._watch_threads.append(t)
+
+    def _watch_broke(self, kind: str, exc: Exception) -> None:
+        """A watch stream died: the local mirror is permanently stale
+        (see class docstring for why there is no transparent resume)."""
+        with self._lock:  # streams die together when the server goes:
+            first = not self.watch_failed  # fire the callback exactly once
+            self.watch_failed = True
+        log.critical(
+            "watch stream for %r broke (%s: %s); this store's mirror is "
+            "frozen — restart the consumer process to resync",
+            kind, type(exc).__name__, exc)
+        if first and self.on_watch_failure is not None:
+            try:
+                self.on_watch_failure()
+            except Exception:  # noqa: BLE001 — never kill the reader hook
+                log.exception("on_watch_failure callback failed")
 
     @staticmethod
     def _deliver(listener, msg: dict) -> None:

@@ -29,8 +29,12 @@ import struct
 import threading
 from typing import Optional
 
+import hmac
+
 from .codec import decode, encode
-from .store import AdmissionError, ClusterStore, ConflictError, NotFoundError
+from .store import (
+    KINDS, AdmissionError, ClusterStore, ConflictError, NotFoundError,
+)
 
 log = logging.getLogger(__name__)
 
@@ -78,9 +82,22 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:  # noqa: D102 — socketserver contract
         sock = self.request
         store: ClusterStore = self.server.store  # type: ignore[attr-defined]
+        token = self.server.token  # type: ignore[attr-defined]
+        self.server.active.add(sock)  # type: ignore[attr-defined]
         try:
             if recv_exact(sock, 4) != MAGIC:
                 return
+            if token:
+                # first frame must authenticate; anything else is refused
+                # before it can touch the store
+                req = recv_frame(sock)
+                presented = req.get("token") or ""
+                if req.get("op") != "auth" or not hmac.compare_digest(
+                        str(presented), token):
+                    send_frame(sock, {"ok": False, "error": "RuntimeError",
+                                      "message": "store auth failed"})
+                    return
+                send_frame(sock, {"ok": True})
             while True:
                 req = recv_frame(sock)
                 op = req.get("op")
@@ -106,6 +123,8 @@ class _Handler(socketserver.BaseRequestHandler):
                                       "message": str(e)})
         except (ConnectionError, OSError):
             pass  # client went away
+        finally:
+            self.server.active.discard(sock)  # type: ignore[attr-defined]
 
     @staticmethod
     def _dispatch(store: ClusterStore, op: str, req: dict) -> dict:
@@ -126,6 +145,8 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True, "objs": [encode(o) for o in objs]}
         if op == "ping":
             return {"ok": True}
+        if op == "auth":
+            return {"ok": True}  # token-less server: auth is a no-op
         raise RuntimeError(f"unknown op {op!r}")
 
     def _serve_watch(self, sock: socket.socket, store: ClusterStore,
@@ -137,6 +158,13 @@ class _Handler(socketserver.BaseRequestHandler):
         (client-go's watch buffers give the reference the same
         isolation)."""
         kinds = req.get("kinds") or [req.get("kind")]
+        bad = [k for k in kinds if k not in KINDS]
+        if bad:
+            # refuse BEFORE subscribing anything: a partially-subscribed
+            # failed request would leak listeners that enqueue forever
+            send_frame(sock, {"ok": False, "error": "RuntimeError",
+                              "message": f"unknown watch kinds {bad}"})
+            return
         replay = bool(req.get("replay", True))
         events: "queue.Queue" = queue.Queue()
 
@@ -147,14 +175,16 @@ class _Handler(socketserver.BaseRequestHandler):
                             "old": encode(old) if old is not None else None})
             return listener
 
-        listeners = [(kind, listener_for(kind)) for kind in kinds]
-        # subscribe with replay: the replayed adds land in the queue
-        # before any post-subscribe event (watch() delivers under the
-        # store lock), preserving list-then-watch ordering
-        for kind, listener in listeners:
-            store.watch(kind, listener, replay=replay)
-        events.put({"stream": "synced"})
+        listeners = []
         try:
+            # subscribe with replay: the replayed adds land in the queue
+            # before any post-subscribe event (watch() delivers under the
+            # store lock), preserving list-then-watch ordering
+            for kind in kinds:
+                listener = listener_for(kind)
+                listeners.append((kind, listener))
+                store.watch(kind, listener, replay=replay)
+            events.put({"stream": "synced"})
             while True:
                 try:
                     payload = events.get(timeout=10.0)
@@ -172,16 +202,27 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 class StoreServer:
-    """Serve a ClusterStore on host:port (TCP, daemon threads)."""
+    """Serve a ClusterStore on host:port (TCP, daemon threads).
+
+    ``token``: shared-secret auth — every connection must open with an
+    auth frame carrying it (the analog of the API server's bearer-token
+    check). REQUIRED for non-loopback binds: the store holds Secrets and
+    the leader-election lease; standalone refuses to expose it
+    unauthenticated."""
 
     def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, token: Optional[str] = None):
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._server = _Server((host, port), _Handler)
         self._server.store = store  # type: ignore[attr-defined]
+        self._server.token = token or ""  # type: ignore[attr-defined]
+        # live connection sockets, so stop() drops watch streams too
+        # (daemon handler threads outlive server_close otherwise and
+        # clients would never learn the server is gone)
+        self._server.active = set()  # type: ignore[attr-defined]
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
 
@@ -199,5 +240,14 @@ class StoreServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        for sock in list(self._server.active):  # type: ignore[attr-defined]
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=5)

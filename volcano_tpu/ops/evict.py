@@ -28,6 +28,7 @@ from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .solver import (
     COMPACT_UNAVAILABLE, NEG, _segment_prefix, le_fits, score_matrix,
@@ -268,6 +269,41 @@ def spread_counts(count, score_j, m_all, f_all, cap_extra):
     c = (c_free + c_extra).astype(jnp.int32)
     cum = jnp.cumsum(c[order]).astype(jnp.float32)
     return c, order, cum
+
+
+def pack_victim_arrays(arr, victims, n_claim: int) -> Dict[str, np.ndarray]:
+    """Build the solve_evict_uniform victim/job arrays for the common
+    single-claiming-gang shape (job slot 0 claims ``n_claim`` uniform
+    tasks; every ``victims`` TaskInfo is eligible). Owns the varrays
+    contract in ONE place — the bench, the multichip dryrun and the suite
+    all feed the kernel through it."""
+    from .arrays import bucket
+
+    node_index = {n.name: i for i, n in enumerate(arr.nodes_list)}
+    ordered = sorted(victims, key=lambda t: node_index[t.node_name])
+    V = bucket(max(len(ordered), 1))
+    J = arr.job_min.shape[0]
+    R = arr.R
+    v_req = np.zeros((V, R), np.float32)
+    v_node = np.zeros(V, np.int32)
+    v_valid = np.zeros(V, bool)
+    for i, t in enumerate(ordered):
+        v_req[i] = t.resreq.to_vector(arr.vocab)
+        v_node[i] = node_index[t.node_name]
+        v_valid[i] = True
+    elig = np.zeros((J, V), bool)
+    elig[0, :len(ordered)] = True
+    need = np.zeros(J, np.int32)
+    need[0] = n_claim
+    job_req = np.zeros((J, R), np.float32)
+    job_req[0] = arr.task_init_req[0]
+    job_acct = np.zeros((J, R), np.float32)
+    job_acct[0] = arr.task_req[0]
+    job_count = np.zeros(J, np.int32)
+    job_count[0] = n_claim
+    return {"v_req": v_req, "v_node": v_node, "v_valid": v_valid,
+            "elig": elig, "job_need": need, "job_req": job_req,
+            "job_acct": job_acct, "job_count": job_count}
 
 
 @functools.partial(jax.jit, static_argnames=(

@@ -13,9 +13,14 @@ Contract notes:
   share/weight) level by level down the two queues' paths; the kernel
   encodes that as a fixed-depth lexicographic key, exact for
   uniform-depth hierarchies ("root/a/b" everywhere). Paths shorter than
-  the deepest are padded with neutral (unsaturated, share 0) levels,
-  which sorts them first where the host comparator would stop at the
-  common depth — an accepted deviation for ragged hierarchies.
+  the deepest are padded with neutral (unsaturated, share 0) levels.
+  On ragged hierarchies the key is a REFINEMENT of the host order:
+  every pair the host comparator decides orders identically (the
+  decision happens at a common-prefix level both encodings share);
+  padding only breaks pairs the host leaves TIED — where the reference
+  falls to its arbitrary-but-stable job-order tiebreak. Fuzzed against
+  the host comparator in tests/test_fairshare.py
+  (TestHDRFRaggedParity).
 - saturation (_resource_saturated, drf.go:93-109): a leaf saturates when
   some dimension's allocation covers its request, or it requests a
   dimension the cluster has exhausted (not "demanding").

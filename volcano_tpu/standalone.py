@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -35,7 +36,10 @@ class Standalone:
                  sidecar_path: Optional[str] = None,
                  metrics_port: int = 0,
                  async_effectors: bool = True,
-                 serve_store: Optional[str] = None):
+                 serve_store: Optional[str] = None,
+                 webhook_client_ca: Optional[str] = None,
+                 webhook_bind: Optional[str] = None,
+                 store_token: Optional[str] = None):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -54,12 +58,28 @@ class Standalone:
             # remote scheduler caches drive this store over TCP
             from .client import StoreServer
             host, _, port = serve_store.rpartition(":")
+            host = host or "127.0.0.1"
+            token = store_token if store_token is not None \
+                else os.environ.get("VOLCANO_STORE_TOKEN", "")
+            if not token and host not in ("127.0.0.1", "localhost", "::1"):
+                # the store holds Secrets and the HA lease; exposing it
+                # unauthenticated beyond loopback hands cluster control
+                # to anything that can reach the port
+                raise ValueError(
+                    f"--serve-store on non-loopback {host!r} requires a "
+                    "shared token (set VOLCANO_STORE_TOKEN)")
             self.store_server = StoreServer(
-                self.store, host or "127.0.0.1", int(port)).start()
+                self.store, host, int(port), token=token).start()
         self.webhook_server = None
         if serve_webhooks_tls:
             from .webhooks import serve_webhooks
-            self.webhook_server = serve_webhooks(self.store)
+            wh_host, wh_port = "127.0.0.1", 0
+            if webhook_bind:
+                h, _, p = webhook_bind.rpartition(":")
+                wh_host, wh_port = (h or "127.0.0.1"), int(p)
+            self.webhook_server = serve_webhooks(
+                self.store, host=wh_host, port=wh_port,
+                client_ca_path=webhook_client_ca)
             self.webhook_server.start_background()
         self.cache = SchedulerCache(self.store,
                                     async_effectors=async_effectors)
@@ -117,10 +137,19 @@ def main(argv=None) -> int:
     ap.add_argument("--sidecar", help="solver sidecar socket path")
     ap.add_argument("--metrics-port", type=int, default=8080)
     ap.add_argument("--jobs-dir", help="apply every .yaml job in this dir")
+    ap.add_argument("--webhook-client-ca", metavar="CA_PEM",
+                    help="require mutual TLS on the admission server: "
+                         "only clients presenting a cert signed by this "
+                         "CA may drive admission")
+    ap.add_argument("--webhook-bind", metavar="[HOST:]PORT",
+                    help="admission server bind address (default "
+                         "loopback, ephemeral port — a deployment that "
+                         "advertises a webhook Service must set this)")
     ap.add_argument("--serve-store", metavar="[HOST:]PORT",
                     help="serve the cluster store over TCP so vcctl "
                          "--server and remote components can drive this "
-                         "control plane")
+                         "control plane; non-loopback binds require "
+                         "VOLCANO_STORE_TOKEN (shared-secret auth)")
     args = ap.parse_args(argv)
 
     conf = None
@@ -131,7 +160,9 @@ def main(argv=None) -> int:
                     serve_webhooks_tls=args.serve_webhooks,
                     sidecar_path=args.sidecar,
                     metrics_port=args.metrics_port,
-                    serve_store=args.serve_store)
+                    serve_store=args.serve_store,
+                    webhook_client_ca=args.webhook_client_ca,
+                    webhook_bind=args.webhook_bind)
     if args.jobs_dir:
         import glob
         import os

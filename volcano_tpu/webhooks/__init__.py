@@ -28,12 +28,15 @@ def start_webhooks(cluster, scheduler_name: str = "volcano") -> WebhookManager:
 
 
 def serve_webhooks(cluster, host: str = "127.0.0.1", port: int = 0,
-                   cert_path=None, key_path=None):
+                   cert_path=None, key_path=None, client_ca_path=None):
     """Register all admission services and serve them over TLS (the
     reference's webhook-manager deployment shape). Returns the server;
-    call .start_background() or .serve_forever()."""
+    call .start_background() or .serve_forever(). Pass client_ca_path to
+    require mutual TLS — any non-loopback deployment should (the k8s
+    manifest wires it)."""
     from .server import AdmissionServer
 
     register_all()
     return AdmissionServer(cluster, host=host, port=port,
-                           cert_path=cert_path, key_path=key_path)
+                           cert_path=cert_path, key_path=key_path,
+                           client_ca_path=client_ca_path)
