@@ -189,12 +189,19 @@ class RemoteClusterStore:
         contract as the in-memory store); live events are then delivered
         from a daemon reader thread under self.locked()."""
         sock = self._connect()
+        # register BEFORE the replay loop: close() must be able to unblock
+        # a watch() stuck mid-replay on a stalled server
+        self._watch_socks.append(sock)
         send_frame(sock, {"op": "watch", "kinds": [kind], "replay": replay})
         while True:
             msg = recv_frame(sock)
             if msg.get("ok") is False:
                 # server refused the subscription (e.g. unknown kind):
                 # surface its message, not a dangling ConnectionError
+                try:
+                    self._watch_socks.remove(sock)
+                except ValueError:
+                    pass
                 sock.close()
                 raise_remote(msg)
             stream = msg.get("stream")
@@ -202,7 +209,6 @@ class RemoteClusterStore:
                 break
             if stream == "event":
                 self._deliver(listener, msg)
-        self._watch_socks.append(sock)
 
         def reader():
             try:

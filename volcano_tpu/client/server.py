@@ -92,8 +92,10 @@ class _Handler(socketserver.BaseRequestHandler):
                 # before it can touch the store
                 req = recv_frame(sock)
                 presented = req.get("token") or ""
+                # compare digests of BYTES: compare_digest on str rejects
+                # non-ASCII tokens with a TypeError
                 if req.get("op") != "auth" or not hmac.compare_digest(
-                        str(presented), token):
+                        str(presented).encode(), token.encode()):
                     send_frame(sock, {"ok": False, "error": "RuntimeError",
                                       "message": "store auth failed"})
                     return

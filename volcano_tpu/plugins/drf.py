@@ -18,7 +18,7 @@ from __future__ import annotations
 import math
 from typing import Dict, Optional
 
-from ..api import Resource
+from ..api import Resource, TaskStatus
 from ..framework import Arguments, EventHandler, Plugin
 from ..metrics import metrics
 
@@ -250,8 +250,15 @@ class DRFPlugin(Plugin):
             # tasks — the same set drf.go:201-214 iterates — so the session
             # open is O(jobs), not O(tasks)
             attr = _DrfAttr(job.allocated.clone())
-            self._update_job_share(job.namespace, job.name, attr)
             self.job_attrs[job.uid] = attr
+            # plain mode orders only jobs with Pending tasks, and the
+            # victim fns recompute shares from attr.allocated on the fly,
+            # so the per-job share precompute (+ gauge write) is skipped
+            # for the steady-state bulk of running jobs; namespace and
+            # hierarchy modes aggregate over every job and keep it
+            if namespace_order or hierarchy \
+                    or TaskStatus.PENDING in job.task_status_index:
+                self._update_job_share(job.namespace, job.name, attr)
 
             if namespace_order:
                 ns_opt = self.namespace_opts.setdefault(

@@ -89,7 +89,19 @@ class GangPlugin(Plugin):
                         fe.set_error(msg)
                         job.nodes_fit_errors[task.key] = fe
             else:
-                ssn.update_pod_group_condition(job, PodGroupCondition(
-                    type=POD_GROUP_SCHEDULED_TYPE, status="True",
-                    transition_id=ssn.uid, reason=POD_GROUP_READY_REASON))
+                # steady-state fast path: when the identical Scheduled
+                # condition is already posted, skip the re-post — only
+                # transition_id/time would change, which the status diff
+                # rule (PodGroupStatus.fingerprint) treats as
+                # insignificant anyway. At 1k ready jobs per cycle the
+                # per-job condition object churn was measurable.
+                if not any(c.type == POD_GROUP_SCHEDULED_TYPE
+                           and c.status == "True"
+                           and c.reason == POD_GROUP_READY_REASON
+                           and not c.message
+                           for c in job.pod_group.status.conditions):
+                    ssn.update_pod_group_condition(job, PodGroupCondition(
+                        type=POD_GROUP_SCHEDULED_TYPE, status="True",
+                        transition_id=ssn.uid,
+                        reason=POD_GROUP_READY_REASON))
         metrics.unschedule_job_count.set(unschedulable_count)

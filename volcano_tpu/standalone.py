@@ -77,6 +77,14 @@ class Standalone:
             if webhook_bind:
                 h, _, p = webhook_bind.rpartition(":")
                 wh_host, wh_port = (h or "127.0.0.1"), int(p)
+            if wh_host not in ("127.0.0.1", "localhost", "::1") \
+                    and not webhook_client_ca:
+                # same fail-closed rule as the store port: an admission
+                # endpoint reachable beyond loopback must authenticate
+                # its clients
+                raise ValueError(
+                    f"--webhook-bind on non-loopback {wh_host!r} requires "
+                    "--webhook-client-ca (mutual TLS)")
             self.webhook_server = serve_webhooks(
                 self.store, host=wh_host, port=wh_port,
                 client_ca_path=webhook_client_ca)
