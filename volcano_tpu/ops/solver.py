@@ -127,9 +127,19 @@ def score_matrix(init_req, idle, used, alloc, params,
     if "binpack" in families:
         w = params["binpack_res_weights"]      # [R]
         wsum = jnp.maximum(jnp.sum(w), 1e-9)
-        # binpack: (req @ (w/alloc)^T + sum_r used*w/alloc) * 100/sum_w
+        # binpack: (sum_r req*(w/alloc) + sum_r used*w/alloc) * 100/sum_w.
+        # The task term is an explicit per-dimension broadcast sum, NOT a
+        # matmul: R is 2-4 (no MXU win) and jnp.dot's default matmul
+        # precision is reduced on some backends, which would break bitwise
+        # parity with the fused pallas kernel (exact f32 VPU arithmetic).
+        R_ = init_req.shape[1]
+        wial = w[None, :] * inv_alloc                              # [N,R]
         bp_node = jnp.sum(used * w[None, :] * inv_alloc, axis=-1)  # [N]
-        bp_task = init_req @ (w[None, :] * inv_alloc).T            # [T,N]
+        bp_task = jnp.zeros((init_req.shape[0], idle.shape[0]),
+                            jnp.float32)
+        for r in range(R_):
+            bp_task = bp_task + (init_req[:, r][:, None]
+                                 * wial[:, r][None, :])
         score += (params["binpack_weight"]
                   * (bp_task + bp_node[None, :]) * (100.0 / wsum))
 
@@ -317,14 +327,16 @@ def _segment_prefix(sorted_vals, seg_start_mask):
     return excl - base
 
 
-def _waterfall_choice(eligible, feas, masked, fit_req, avail, npods,
+def _waterfall_choice(eligible, node_score, fit_req, avail, npods,
                       max_pods, thr, scalar_mask, mode: str):
     """Spread a herd across nodes in one round.
 
     When many tasks prefer the same node (binpack's global argmax, or
     least-requested's identical-nodes tie), per-task argmax fills one node
-    per round. Instead, order nodes by their herd desirability and
-    pre-assign task *positions* to nodes:
+    per round. Instead, order nodes by their herd desirability
+    (``node_score`` = per-node max of the masked score — computed by the
+    dense path or the fused pallas kernel) and pre-assign task *positions*
+    to nodes:
 
     - pack mode: task position p lands on the node where cumulative slot
       capacity first exceeds p (fills best node to capacity, then next) —
@@ -335,8 +347,8 @@ def _waterfall_choice(eligible, feas, masked, fit_req, avail, npods,
     Tasks for which the pre-assigned node is infeasible fall back to their
     personal argmax; prefix admission corrects slot overestimates.
     """
-    T, N = feas.shape
-    node_score = jnp.max(masked, axis=0)                            # [N]
+    T = eligible.shape[0]
+    N = node_score.shape[0]
     # mean eligible request estimates per-node slot counts (the estimate
     # only steers TARGETING — prefix admission is exact; quantile
     # estimators were tried and lose to the mean across the parity corpus)
@@ -380,13 +392,13 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
     """One parallel round: choose best node per task (waterfall-corrected),
     admit by priority prefix within each node, return (new_assign[T]
     node/-1, debit[N,R], pod_inc[N])."""
-    T, N = feas.shape
     pods_ok = (npods < max_pods)[None, :]
     feas = feas & pods_ok & eligible[:, None]
     masked = jnp.where(feas, score, NEG)
     personal = jnp.argmax(masked, axis=1).astype(jnp.int32)        # [T]
     if herd_mode in ("pack", "spread") and per_node_cap == 0:
-        target = _waterfall_choice(eligible, feas, masked, fit_req, avail,
+        node_score = jnp.max(masked, axis=0)                       # [N]
+        target = _waterfall_choice(eligible, node_score, fit_req, avail,
                                    npods, max_pods, thr, scalar_mask,
                                    herd_mode)
         t_ok = jnp.take_along_axis(feas, target[:, None], axis=1)[:, 0]
@@ -395,7 +407,57 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
         choice = personal
     has = jnp.take_along_axis(feas, choice[:, None], axis=1)[:, 0]
     choice = jnp.where(has, choice, -1)
+    return _admit_prefix(choice, fit_req, acct_req, avail, rank, thr,
+                         scalar_mask, npods, max_pods, per_node_cap)
 
+
+def _admission_round_fused(eligible, a, avail, used_now, sig_feas, sig_i8,
+                           inv_alloc, node_static, pars, acct_req, rank,
+                           thr, scalar_mask, npods, herd_mode: str,
+                           score_families):
+    """The fused-kernel form of _admission_round: the [T,N] feasibility/
+    score/argmax/node-max pass runs in ONE pallas kernel (HBM traffic per
+    round drops from several [T,N] float32 matrices to the int8 signature
+    mask + [T]/[N] vectors); the feasibility of the two *chosen* nodes is
+    re-derived pointwise. Only the waterfall herd modes take this path
+    (per_node_cap fidelity mode stays dense)."""
+    from .pallas_kernels import fused_choice
+
+    fit_req = a["task_init_req"]
+    max_pods = a["node_max_pods"]
+    pods_ok = npods < max_pods
+    best_s, best_i, node_score = fused_choice(
+        fit_req, avail, used_now, inv_alloc, node_static,
+        eligible.astype(jnp.float32), pods_ok.astype(jnp.float32),
+        sig_i8, pars, score_families)
+    has_any = best_s > NEG * 0.5
+    personal = best_i
+
+    def feas_point(node_idx):
+        """feasibility of (task, node_idx[task]) — identical rule to the
+        dense feas matrix, evaluated at one node per task."""
+        av = avail[node_idx]                                   # [T,R]
+        fit = le_fits(fit_req, av, thr, scalar_mask)
+        sig = jnp.take_along_axis(sig_feas, node_idx[:, None],
+                                  axis=1)[:, 0]
+        return fit & sig & pods_ok[node_idx] & eligible
+
+    target = _waterfall_choice(eligible, node_score, fit_req, avail,
+                               npods, max_pods, thr, scalar_mask,
+                               herd_mode)
+    t_ok = feas_point(target)
+    choice = jnp.where(t_ok, target,
+                       jnp.where(has_any, personal, -1))
+    return _admit_prefix(choice, fit_req, acct_req, avail, rank, thr,
+                         scalar_mask, npods, max_pods, 0)
+
+
+def _admit_prefix(choice, fit_req, acct_req, avail, rank, thr,
+                  scalar_mask, npods, max_pods, per_node_cap: int):
+    """Priority-prefix admission for a round's per-task node choices
+    (shared by the dense and fused choice paths)."""
+    T = choice.shape[0]
+    N = avail.shape[0]
     # sort by (node, rank); inactive last
     key = jnp.where(choice >= 0, choice * (T + 1) + rank, BIG_KEY)
     perm = jnp.argsort(key)
@@ -442,7 +504,8 @@ def _admission_round(eligible, feas, score, fit_req, acct_req, avail,
                                              "use_queue_cap",
                                              "use_drf_order",
                                              "use_hdrf_order",
-                                             "work_conserving"))
+                                             "work_conserving",
+                                             "fused"))
 def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    score_params: Dict[str, jnp.ndarray],
                    max_rounds: int = 64,
@@ -453,7 +516,8 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                    use_queue_cap: bool = False,
                    use_drf_order: bool = False,
                    use_hdrf_order: bool = False,
-                   work_conserving: bool = True) -> SolveResult:
+                   work_conserving: bool = True,
+                   fused: str = "auto") -> SolveResult:
     """Round-based allocate+pipeline solve with in-kernel gang semantics.
 
     With ``use_queue_cap`` (proportion plugin active) per-queue deserved is
@@ -478,6 +542,27 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     sig_feas = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]  # [T,N]
     rank = a["task_rank"]
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
+
+    # fused pallas choice kernel (TPU): the per-round [T,N] feasibility/
+    # score/argmax pass in one VMEM-resident kernel. "auto" = on-device
+    # when the shape tiles cleanly and the round uses the waterfall herd
+    # modes; "on"/"off" force (tests exercise the kernel in interpret
+    # mode on CPU via "on").
+    from .pallas_kernels import fused_choice_auto
+    use_fused = fused == "on" or (
+        fused == "auto" and jax.default_backend() == "tpu"
+        and fused_choice_auto(T, N)
+        and herd_mode in ("pack", "spread") and per_node_cap == 0)
+    if use_fused and (herd_mode not in ("pack", "spread")
+                      or per_node_cap != 0):
+        use_fused = False  # fused path implements only the herd modes
+    if use_fused:
+        from .pallas_kernels import pack_pars
+        R_ = a["task_init_req"].shape[1]
+        sig_i8 = sig_feas.astype(jnp.int8)
+        inv_alloc = 1.0 / a["node_alloc"]
+        fused_pars = pack_pars(score_params, R_)
+        node_static = jnp.asarray(score_params["node_static"], jnp.float32)
 
     if use_queue_cap:
         total = jnp.sum(
@@ -543,15 +628,23 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 eligible = eligible & _queue_cap_mask(
                     eligible, task_queue, a["task_req"], qrem, thr,
                     scalar_mask, qp, q_seg_start)
-            feas = fits_matrix(a["task_init_req"], avail, thr, scalar_mask) & sig_feas
             used_now = a["node_used"] + (a["node_idle"] - idle)
-            score = score_matrix(a["task_init_req"], avail, used_now,
-                                 a["node_alloc"], score_params,
-                                 score_families)
-            new_assign, debit, pod_inc = _admission_round(
-                eligible, feas, score, a["task_init_req"], a["task_req"],
-                avail, r_rank, thr, scalar_mask, npods, a["node_max_pods"],
-                per_node_cap, herd_mode)
+            if use_fused:
+                new_assign, debit, pod_inc = _admission_round_fused(
+                    eligible, a, avail, used_now, sig_feas, sig_i8,
+                    inv_alloc, node_static, fused_pars, a["task_req"],
+                    r_rank, thr, scalar_mask, npods, herd_mode,
+                    score_families)
+            else:
+                feas = fits_matrix(a["task_init_req"], avail, thr,
+                                   scalar_mask) & sig_feas
+                score = score_matrix(a["task_init_req"], avail, used_now,
+                                     a["node_alloc"], score_params,
+                                     score_families)
+                new_assign, debit, pod_inc = _admission_round(
+                    eligible, feas, score, a["task_init_req"],
+                    a["task_req"], avail, r_rank, thr, scalar_mask, npods,
+                    a["node_max_pods"], per_node_cap, herd_mode)
             got = new_assign >= 0
             assigned = jnp.where(got, new_assign, assigned)
             kind = jnp.where(got, jnp.int32(1 if use_future else 0), kind)
