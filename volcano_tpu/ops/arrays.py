@@ -23,7 +23,7 @@ import numpy as np
 
 from ..api import (
     JobInfo, NodeInfo, Resource, ResourceVocab, TaskInfo, TaskStatus,
-    MIN_MILLI_SCALAR,
+    MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR,
 )
 
 #: compile-bucket sizes: quarter-steps between powers of two, floor 8 —
@@ -365,17 +365,39 @@ class FlattenCache:
                 and ent["R"] == R and ent["uids"] == uids):
             return ent
         k = len(tasks)
+        # bulk cpu/mem extraction: one list-comprehension + np.array beats
+        # 2k per-task to_vector calls ~5x (the all-cold burst flatten is
+        # this loop); scalar resources overlay the rare rows after
         init = np.zeros((k, R), dtype=np.float32)
         req = np.zeros((k, R), dtype=np.float32)
-        counts = np.zeros(k, dtype=bool)
+        init[:, :2] = np.array(
+            [(t.init_resreq.milli_cpu, t.init_resreq.memory)
+             for t in tasks], dtype=np.float32).reshape(k, 2)
+        req[:, :2] = np.array(
+            [(t.resreq.milli_cpu, t.resreq.memory)
+             for t in tasks], dtype=np.float32).reshape(k, 2)
+        any_scalar = np.zeros(k, dtype=bool)
+        for i, t in enumerate(tasks):
+            if t.init_resreq.scalars or t.resreq.scalars:
+                for name, v in t.init_resreq.scalars.items():
+                    if v >= MIN_MILLI_SCALAR:
+                        # vocab-independent, like Resource.is_empty
+                        any_scalar[i] = True
+                    idx = vocab.index(name)
+                    if idx is not None:
+                        init[i, idx] = v
+                for name, v in t.resreq.scalars.items():
+                    idx = vocab.index(name)
+                    if idx is not None:
+                        req[i, idx] = v
+        # not is_empty(): the api.resource thresholds
+        counts = ((init[:, 0] >= MIN_MILLI_CPU)
+                  | (init[:, 1] >= MIN_MEMORY) | any_scalar)
         sig_uniq: List[str] = []
         sig_reps: List[TaskInfo] = []
         sig_idx: Dict[str, int] = {}
         sig_local = np.zeros(k, dtype=np.int32)
         for i, t in enumerate(tasks):
-            init[i] = t.init_resreq.to_vector(vocab)
-            req[i] = t.resreq.to_vector(vocab)
-            counts[i] = not t.init_resreq.is_empty()
             s = _signature(t)
             li = sig_idx.get(s)
             if li is None:
