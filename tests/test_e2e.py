@@ -684,3 +684,86 @@ class TestMpiExample:
 
         # the gang ran: job reports Running with 3 running replicas
         assert w.phase("mpi-demo").value == "Running"
+
+
+class TestExampleIntegrations:
+    """The remaining example/ workloads run end to end: the TF ps/worker
+    gang (env+svc wiring) and the hierarchical-queue jobs applied through
+    `vcctl apply -f` (reference example/integrations/tensorflow +
+    example/hierarchical-jobs)."""
+
+    def _example(self, name):
+        import os
+        return os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "example", name)
+
+    def test_tensorflow_job_schedules_with_wiring(self):
+        import yaml
+
+        from volcano_tpu.cli.vcctl import _job_from_yaml
+
+        with open(self._example("tensorflow-job.yaml")) as f:
+            job = _job_from_yaml(yaml.safe_load(f))
+        w = World(nodes=2, node_cpu="2", node_mem="4Gi")
+        w.store.create("jobs", job)
+        w.converge(cycles=4)
+
+        pods = w.pods("tf-demo")
+        assert sorted(p.name for p in pods) == [
+            "tf-demo-ps-0", "tf-demo-worker-0", "tf-demo-worker-1"]
+        assert all(p.node_name for p in pods)
+        # svc plugin publishes per-task hosts files for the TF bootstrap
+        cm = w.store.get("configmaps", "tf-demo-svc", "default")
+        assert cm.data["ps.host"] == "tf-demo-ps-0.tf-demo"
+        assert cm.data["worker.host"] == (
+            "tf-demo-worker-0.tf-demo\ntf-demo-worker-1.tf-demo")
+        # env plugin: VK_TASK_INDEX per replica
+        for p in pods:
+            envs = {e["name"]: e["value"]
+                    for c in p.containers for e in c.get("env", [])}
+            assert envs.get("VK_TASK_INDEX") == p.name.rsplit("-", 1)[1]
+        assert w.phase("tf-demo").value == "Running"
+
+    def test_hierarchical_example_applies_and_splits(self):
+        from volcano_tpu.cli.vcctl import main as vcctl
+        from volcano_tpu.conf import (
+            Configuration, PluginOption, Tier,
+        )
+
+        conf = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+    arguments:
+      drf.enableHierarchy: true
+  - name: predicates
+  - name: nodeorder
+"""
+        # 6 cpu total vs 9 demanded: the weighted tree must bind
+        w = World(nodes=3, node_cpu="2", node_mem="8Gi", conf=conf)
+        out = vcctl(["apply", "-f",
+                     self._example("hierarchical-jobs.yaml")],
+                    cluster=w.store)
+        assert "queue/root-eng-prod" in out and "job/sci-job" in out
+        w.converge(cycles=4)
+
+        placed = {}
+        for name in ("eng-prod-job", "eng-dev-job", "sci-job"):
+            placed[name] = sum(1 for p in w.pods(name) if p.node_name)
+        total = sum(placed.values())
+        # the current hdrf contract (ops.hdrf KNOWN DEVIATION): work
+        # conserving and starvation-free under the default
+        # priority-before-drf conf — the in-kernel re-rank composes the
+        # static priority order as a major key instead of freezing the
+        # snapshot order (which used to hand everything to the
+        # first-created jobs); the WEIGHTED tree split on
+        # uniform-dominant-resource hierarchies needs the
+        # hierarchy-aware progressive cap (round-5 lever), so the split
+        # here converges egalitarian rather than 8:2.
+        assert total == 12, placed  # 6 cpus / 500m, all capacity used
+        assert all(v >= 3 for v in placed.values()), placed  # no starvation

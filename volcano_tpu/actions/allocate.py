@@ -213,22 +213,43 @@ class AllocateAction(Action):
             self._fill_queue_arrays(arr, queue_opts, ssn)
 
         # live DRF ordering on device (drf plugin active): the kernel
-        # re-ranks jobs by dominant share every round. Only when drf is
-        # the effective job-order authority: any OTHER job-order plugin
-        # dispatched before it (e.g. a higher-tier priority plugin, whose
-        # strict precedence the share re-rank would override) keeps the
-        # static composite order. gang's unready-first ordering above drf
-        # is tolerated — the flatten holds pending-task jobs, for which
-        # progressive filling and unready-first are compatible.
+        # re-ranks jobs by dominant share every round. Job-order providers
+        # dispatched BEFORE drf in the tiers (priority, gang) compose as a
+        # static MAJOR rank (arr.job_drf_prerank) that live shares only
+        # tie-break — the reference's comparator chain returns on the
+        # first non-zero, so strict priorities dominate and equal
+        # priorities fall through to drf, which the kernel now mirrors
+        # instead of disabling the re-rank outright (a disabled re-rank
+        # froze the snapshot order and could starve later-created jobs
+        # under the default priority-before-drf conf). Falls back to the
+        # static order only when a preceding provider registered no sort
+        # key.
         drf_opts = ssn.solver_options.get("drf_order")
         use_drf_order = bool(drf_opts) and not sequential
         if use_drf_order:
             providers = [name for _, name, _
                          in ssn._tier_fns("job_order_fns")]
-            if "drf" not in providers or any(
-                    p not in ("gang", "drf")
-                    for p in providers[:providers.index("drf")]):
+            if "drf" not in providers:
                 use_drf_order = False
+            else:
+                pre = providers[:providers.index("drf")]
+                keyfns = [ssn.order_key_fns.get(
+                    "job_order_fns", {}).get(p) for p in pre]
+                if any(kf is None for kf in keyfns):
+                    use_drf_order = False
+                elif keyfns:
+                    keys = [tuple(kf(job) for kf in keyfns)
+                            for job in arr.jobs_list]
+                    order = sorted(range(len(keys)), key=keys.__getitem__)
+                    # dense rank; EQUAL key tuples share a rank so shares
+                    # can tie-break across them
+                    prev = None
+                    rank_val = -1
+                    for j in order:
+                        if keys[j] != prev:
+                            rank_val += 1
+                            prev = keys[j]
+                        arr.job_drf_prerank[j] = rank_val
         use_hdrf_order = False
         if use_drf_order:
             attrs = drf_opts["job_attrs"]

@@ -33,10 +33,22 @@ def pod_key(pod) -> str:
     return f"{pod.namespace}/{pod.name}"
 
 
+def container_requests(c) -> dict:
+    """A container's resource requests, accepting both the k8s pod-spec
+    shape ({"resources": {"requests": ...}} — what job templates and any
+    YAML-born pod carry) and the flat {"requests": ...} shorthand the
+    in-process builders use. Without the nested form, template-defined
+    jobs silently became best-effort."""
+    r = c.get("requests")
+    if r is None:
+        r = (c.get("resources") or {}).get("requests")
+    return r or {}
+
+
 def get_pod_resource_without_init_containers(pod) -> Resource:
     r = Resource()
     for c in pod.containers:
-        r.add(Resource.from_resource_list(c.get("requests", {})))
+        r.add(Resource.from_resource_list(container_requests(c)))
     return r
 
 
@@ -44,7 +56,8 @@ def get_pod_resource_request(pod) -> Resource:
     """Max(sum(containers), max(initContainers)) (k8s launch request)."""
     r = get_pod_resource_without_init_containers(pod)
     for c in pod.init_containers:
-        r.set_max_resource(Resource.from_resource_list(c.get("requests", {})))
+        r.set_max_resource(
+            Resource.from_resource_list(container_requests(c)))
     return r
 
 

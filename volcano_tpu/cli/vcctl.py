@@ -193,6 +193,51 @@ def job_delete(args, cluster) -> str:
 # queue commands (pkg/cli/queue)
 # ---------------------------------------------------------------------------
 
+def apply_file(args, cluster: ClusterStore) -> str:
+    """Apply every document of a (multi-doc) YAML file — Jobs, Queues and
+    PodGroups, dispatched by `kind` (the kubectl-apply shape the
+    reference's examples assume, e.g. example/hierarchical-jobs)."""
+    from ..models import PodGroup, PodGroupSpec
+
+    applied = []
+    with open(args.filename) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    # validate BEFORE applying anything: a bad document must not leave
+    # the file half-applied (kubectl validates the whole file first)
+    supported = {"Job", "Queue", "PodGroup"}
+    bad = [d.get("kind", "Job") for d in docs
+           if d.get("kind", "Job") not in supported]
+    if bad:
+        return (f"unsupported kind(s) {sorted(set(bad))} in "
+                f"{args.filename}; nothing applied")
+    for raw in docs:
+        kind = raw.get("kind", "Job")
+        meta = raw.get("metadata", {})
+        if kind == "Job":
+            obj = _job_from_yaml(raw)
+            cluster.apply("jobs", obj)
+        elif kind == "Queue":
+            spec = raw.get("spec", {})
+            obj = Queue(name=meta.get("name", "queue"),
+                        annotations=meta.get("annotations", {}) or {},
+                        spec=QueueSpec(
+                            weight=int(spec.get("weight", 1)),
+                            capability=spec.get("capability", {}) or {}))
+            cluster.apply("queues", obj)
+        elif kind == "PodGroup":
+            spec = raw.get("spec", {})
+            obj = PodGroup(
+                name=meta.get("name", "podgroup"),
+                namespace=meta.get("namespace", "default"),
+                annotations=meta.get("annotations", {}) or {},
+                spec=PodGroupSpec(
+                    min_member=int(spec.get("minMember", 1)),
+                    queue=spec.get("queue", "default")))
+            cluster.apply("podgroups", obj)
+        applied.append(f"{kind.lower()}/{meta.get('name', '?')}")
+    return "applied " + ", ".join(applied)
+
+
 def queue_create(args, cluster: ClusterStore) -> str:
     q = Queue(name=args.name, spec=QueueSpec(weight=args.weight))
     cluster.create("queues", q)
@@ -297,6 +342,9 @@ def build_parser() -> argparse.ArgumentParser:
     qo.add_argument("--weight", "-w", type=int, default=None)
     qo.add_argument("--action", "-a", choices=["open", "close"], default=None)
 
+    applyp = sub.add_parser("apply")
+    applyp.add_argument("--filename", "-f", required=True)
+
     sub.add_parser("version")
     return p
 
@@ -313,6 +361,7 @@ _DISPATCH = {
     ("queue", "get"): queue_get,
     ("queue", "operate"): queue_operate,
     ("queue", "delete"): queue_delete,
+    ("apply", None): apply_file,
 }
 
 #: standalone binary aliases (cmd/cli/{vsub,vjobs,...})

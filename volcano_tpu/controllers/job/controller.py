@@ -12,6 +12,7 @@ import logging
 from typing import Dict, List, Optional
 
 from ...api import Resource
+from ...api.job_info import container_requests
 from ...api.types import POD_GROUP_ANNOTATION
 from ...client.store import AdmissionError, ClusterStore, NotFoundError
 from ...models import (
@@ -294,7 +295,7 @@ class JobController(Controller):
         total = Resource()
         remaining = job.spec.min_available
         for task in job.spec.tasks:
-            reqs = [c.get("requests", {}) for c in
+            reqs = [container_requests(c) for c in
                     (task.template.get("spec", {}).get("containers", []))]
             per_pod = Resource()
             for r in reqs:

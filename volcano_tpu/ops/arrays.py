@@ -175,6 +175,11 @@ class SnapshotArrays:
     # plugin's session-open attrs; zeros when drf is inactive)
     job_drf_allocated: np.ndarray = None  # [J,R]
     drf_total: np.ndarray = None          # [R]
+    #: static MAJOR ordering key for the in-kernel drf/hdrf re-rank: dense
+    #: rank from the job-order providers that precede drf in the tiers
+    #: (priority/gang) — live shares only break its ties, so a strict
+    #: priority is never inverted by a share re-rank
+    job_drf_prerank: np.ndarray = None    # [J] int32
     # hierarchical-DRF tree (ops.hdrf.build_hdrf; None unless hdrf active)
     hdrf_parent: np.ndarray = None        # [H]
     hdrf_weight: np.ndarray = None        # [H]
@@ -292,6 +297,7 @@ class SnapshotArrays:
             "job_valid": self.job_valid,
             "job_drf_allocated": self.job_drf_allocated,
             "drf_total": self.drf_total,
+            "job_drf_prerank": self.job_drf_prerank,
             "node_idle": self.node_idle,
             "node_extra_future": self.node_extra_future,
             "node_used": self.node_used,
@@ -738,6 +744,7 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
     arr.job_drf_allocated = np.zeros((arr.job_min.shape[0], R),
                                      dtype=np.float32)
     arr.drf_total = np.zeros(R, dtype=np.float32)
+    arr.job_drf_prerank = np.zeros(arr.job_min.shape[0], dtype=np.int32)
 
     arr.thresholds = vocab.thresholds()
     arr.scalar_dim_mask = np.zeros(R, dtype=bool)

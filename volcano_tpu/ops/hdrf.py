@@ -128,6 +128,18 @@ def hdrf_rank_state(a, rank):
     a["job_drf_allocated"] + jobres. Shares recompute bottom-up by depth
     level (children of depth-d nodes are exactly depth d+1), then jobs
     sort by the per-level (saturated, share/weight) lexicographic key.
+
+    KNOWN DEVIATION (round-5 lever): the progressive-filling cap paired
+    with this rank is the plain LEAF-share cap (ops.solver.drf_state),
+    which converges uniform-dominant-resource hierarchies toward
+    egalitarian per-job splits instead of the weighted tree split the
+    host comparator reaches placement-by-placement. A hierarchy-aware
+    cap (gating each job's growth at every ancestor level against live
+    sibling subtree keys) fixes the uniform case but regresses
+    disjoint-dominant-resource rescaling (eng children on different
+    dims must BOTH fill past naive subtree parity); it needs to be
+    dimension-aware before it can ship. tests/test_e2e.py
+    TestExampleIntegrations encodes the current contract.
     """
     import jax
     import jax.numpy as jnp
@@ -154,7 +166,8 @@ def hdrf_rank_state(a, rank):
                       jnp.where(alloc > 0.0, 1.0, 0.0))
         return jnp.max(s, axis=1)
 
-    def hdrf_rank(jobres):
+    def tree_state(jobres):
+        """(share[H], sat[H]) after the bottom-up weighted recursion."""
         alloc = jnp.zeros((H, a["drf_total"].shape[0]), jnp.float32)
         alloc = alloc.at[job_leaf].add(a["job_drf_allocated"] + jobres)
         total_alloc = a["hdrf_total_allocated"] + jnp.sum(jobres, axis=0)
@@ -185,10 +198,15 @@ def hdrf_rank_state(a, rank):
             alloc = jnp.where(tgt[:, None], alloc_p, alloc)
             share = jnp.where(tgt, share_of(alloc_p), share)
             sat = jnp.where(tgt, sat_p, sat)
+        return share, sat
+
+    def hdrf_rank(jobres):
+        share, sat = tree_state(jobres)
 
         # per-level lexicographic job key: level 1 is most significant;
         # within a level saturation dominates share/weight
-        # (drf.go _compareQueues)
+        # (drf.go _compareQueues). The pre-drf provider rank (priority/
+        # gang) tops even that — see job_drf_prerank.
         keys = [jnp.arange(J, dtype=jnp.int32)]  # final tie: static order
         for lvl in range(D - 1, -1, -1):
             anc = ancestors[:, lvl]                           # [J]
@@ -196,6 +214,9 @@ def hdrf_rank_state(a, rank):
             anc_c = jnp.maximum(anc, 0)
             keys.append(jnp.where(ok, share[anc_c] / weight[anc_c], 0.0))
             keys.append(jnp.where(ok, sat[anc_c], False))
+        prerank = a.get("job_drf_prerank")
+        keys.append(prerank if prerank is not None
+                    else jnp.zeros(J, jnp.int32))
         order_j = jnp.lexsort(tuple(keys))
         job_pos = jnp.zeros(J, jnp.int32).at[order_j].set(
             jnp.arange(J, dtype=jnp.int32))

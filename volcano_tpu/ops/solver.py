@@ -230,9 +230,18 @@ def drf_state(a, rank):
                       jobres / drf_total[None, :], 0.0), axis=1)     # [J]
         return jnp.where(a["job_valid"], share, jnp.inf)
 
+    # static MAJOR key from the job-order providers preceding drf in the
+    # tiers (priority/gang): live shares only break its ties, so a strict
+    # priority never inverts under the share re-rank. Zeros when nothing
+    # precedes drf (pure share order, the original behavior). .get():
+    # hand-built array dicts (fuzz/bench) predate the key.
+    prerank = a.get("job_drf_prerank")
+    if prerank is None:
+        prerank = jnp.zeros(J, jnp.int32)
+
     def drf_rank(jobres):
-        job_pos = jnp.zeros(J, jnp.int32).at[
-            jnp.argsort(drf_share(jobres), stable=True)].set(
+        order_j = jnp.lexsort((drf_share(jobres), prerank))
+        job_pos = jnp.zeros(J, jnp.int32).at[order_j].set(
             jnp.arange(J, dtype=jnp.int32))
         order_t = jnp.lexsort((within_rank, job_pos[a["task_job"]]))
         return jnp.zeros(T, jnp.int32).at[order_t].set(
@@ -243,7 +252,13 @@ def drf_state(a, rank):
         elig_job = jnp.zeros(J, jnp.int32).at[a["task_job"]].max(
             eligible.astype(jnp.int32)) > 0
         n_elig = jnp.maximum(jnp.sum(elig_job), 1)
-        m = jnp.min(jnp.where(elig_job, share, jnp.inf))
+        # progressive filling competes WITHIN a prerank group: a
+        # higher-priority job must not be throttled against (or yield
+        # headroom to) lower-priority shares
+        grp = jnp.clip(prerank, 0, J - 1)
+        m_grp = jax.ops.segment_min(
+            jnp.where(elig_job, share, jnp.inf), grp, num_segments=J)
+        m = m_grp[grp]                                           # [J]
         max_incr = jnp.max(jnp.where(eligible, incr_t, 0.0))
         step = jnp.maximum(max_incr, 1.0 / (8.0 * n_elig))
         allowed = jnp.maximum(share, m) + step                   # [J]
@@ -581,8 +596,8 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         jobres0, drf_rank, drf_cap = drf_state(a, rank)
         if use_hdrf_order:
             # hierarchical comparator replaces the plain dominant-share
-            # ranking; the progressive-filling cap (drf_cap) still works
-            # on leaf (job) shares
+            # ranking; the progressive-filling cap stays the leaf-share
+            # one (see ops.hdrf.hdrf_rank_state's KNOWN DEVIATION note)
             from .hdrf import hdrf_rank_state
             drf_rank = hdrf_rank_state(a, rank)
     else:

@@ -96,7 +96,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
     if use_drf_order:
         # live DRF ordering: shares are [J] reductions over replicated
         # job state, identical on every device
-        in_specs.update({"job_drf_allocated": P(), "drf_total": P()})
+        in_specs.update({"job_drf_allocated": P(), "drf_total": P(),
+                         "job_drf_prerank": P()})
     if use_hdrf_order:
         # hierarchical DRF: the queue-path tree is tiny and its share
         # recursion runs on replicated [H]/[J] state (ops/hdrf.py).
