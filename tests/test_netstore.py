@@ -290,6 +290,28 @@ spec:
                 cwd=os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))))
             assert "net-job" in out.stdout
+
+            # multi-doc apply over the wire too
+            q_yaml = tmp_path / "q.yaml"
+            q_yaml.write_text(
+                "kind: Queue\nmetadata: {name: wire-q}\n"
+                "spec: {weight: 3}\n"
+                "---\n"
+                "kind: PodGroup\n"
+                "metadata: {name: wire-pg, namespace: default}\n"
+                "spec: {minMember: 2}\n")
+            out = subprocess.run(
+                [sys.executable, "-m", "volcano_tpu.cli",
+                 "--server", f"127.0.0.1:{port}",
+                 "apply", "-f", str(q_yaml)],
+                env=env, capture_output=True, text=True, timeout=60,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+            assert "queue/wire-q" in out.stdout, (out.stdout, out.stderr)
+            assert "podgroup/wire-pg" in out.stdout
+            assert remote.get("queues", "wire-q").spec.weight == 3
+            pg = remote.get("podgroups", "wire-pg", "default")
+            assert pg.spec.min_member == 2 and pg.spec.queue == "default"
         finally:
             proc.terminate()
             try:
