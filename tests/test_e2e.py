@@ -756,14 +756,19 @@ tiers:
         for name in ("eng-prod-job", "eng-dev-job", "sci-job"):
             placed[name] = sum(1 for p in w.pods(name) if p.node_name)
         total = sum(placed.values())
-        # the current hdrf contract (ops.hdrf KNOWN DEVIATION): work
-        # conserving and starvation-free under the default
-        # priority-before-drf conf — the in-kernel re-rank composes the
-        # static priority order as a major key instead of freezing the
-        # snapshot order (which used to hand everything to the
-        # first-created jobs); the WEIGHTED tree split on
-        # uniform-dominant-resource hierarchies needs the
-        # hierarchy-aware progressive cap (round-5 lever), so the split
-        # here converges egalitarian rather than 8:2.
+        # the WEIGHTED hierarchical contract (ops.hdrf hdrf_state): the
+        # 8-weight prod queue dominates its 2-weight dev sibling the way
+        # the reference's per-placement tree re-sort does. The
+        # reference-faithful host path lands on prod 6 / dev 2 / sci 4
+        # (prod saturates its full request; eng's rescaled share then
+        # jumps past sci, handing sci the remainder); the round solver
+        # converges to the same shape within one task of drift (round
+        # -batched admission vs the reference's strictly sequential
+        # place-one-then-resort loop — the documented rounds granularity
+        # trade, cf. config2 in BENCH).
         assert total == 12, placed  # 6 cpus / 500m, all capacity used
-        assert all(v >= 3 for v in placed.values()), placed  # no starvation
+        assert placed["eng-prod-job"] >= 5, placed  # weighted dominance
+        assert placed["eng-dev-job"] <= 3, placed
+        assert placed["eng-prod-job"] >= 2 * placed["eng-dev-job"] - 1, \
+            placed  # the 8:2-shaped prod/dev ratio
+        assert placed["sci-job"] >= 3, placed

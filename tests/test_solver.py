@@ -479,3 +479,42 @@ class TestFusedChoiceParity:
         assert fused_choice_supported(10240, 2048)  # headline: 512-tiles
         # huge axis with no 128-divisor: no clean tiling -> dense path
         assert not fused_choice_supported(10240, 3000)
+
+    def test_fused_matches_dense_hdrf(self):
+        """The hdrf branch takes an EXTRA fused pass per round (the
+        placeability prefilter) — exercise fused="on" with the
+        hierarchical rank+cap so that path can't regress silently (it
+        once hit a NameError reachable only on TPU/forced-fused runs)."""
+        import numpy as np
+        from types import SimpleNamespace
+
+        from volcano_tpu.api import Resource
+        from volcano_tpu.ops.hdrf import build_hdrf
+        from volcano_tpu.ops.solver import solve_allocate
+
+        arr = self._problem(seed=5)
+        queues = {}
+        hier = [("root/a", "10/8"), ("root/b", "10/2"),
+                ("root/c/x", "10/5/6"), ("root/c/y", "10/5/2")]
+        for k, job in enumerate(arr.jobs_list):
+            h, w = hier[k % 4]
+            qn = f"q{k % 4}"
+            job.queue = qn
+            queues[qn] = SimpleNamespace(
+                name=qn, weight=1, capability=None, hierarchy=h,
+                weights=w)
+        arr.drf_total = (arr.node_alloc
+                         * arr.node_valid[:, None]).sum(axis=0).astype(
+            np.float32)
+        build_hdrf(arr, queues, {}, Resource())
+        p = params_dict(arr, binpack_weight=1.0)
+        d = arr.device_dict()
+        kw = dict(herd_mode="pack", score_families=("binpack",),
+                  use_drf_order=True, use_hdrf_order=True)
+        r_off = solve_allocate(d, p, fused="off", **kw)
+        r_on = solve_allocate(d, p, fused="on", **kw)
+        assert (np.asarray(r_off.kind) == np.asarray(r_on.kind)).all()
+        assert (np.asarray(r_off.job_ready)
+                == np.asarray(r_on.job_ready)).all()
+        a_off, a_on = np.asarray(r_off.assigned), np.asarray(r_on.assigned)
+        assert ((a_off >= 0) == (a_on >= 0)).all()

@@ -487,6 +487,13 @@ class AllocateAction(Action):
                 continue
             jobs = queue_map.get(queue.name)
             if jobs is None or jobs.empty():
+                # drained queue: drop it and keep the namespace live so
+                # its OTHER queues still pop (allocate.go:165-171 pops
+                # the empty queue off the heap and continues; dropping
+                # the namespace here would strand every sibling queue)
+                queue_map.pop(queue.name, None)
+                if any(not q.empty() for q in queue_map.values()):
+                    namespaces.push(ns)
                 continue
             job = jobs.pop()
             if job.uid not in pending_tasks:

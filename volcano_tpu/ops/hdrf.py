@@ -1,4 +1,4 @@
-"""Hierarchical DRF ordering in the kernel.
+"""Hierarchical DRF ordering + progressive-filling cap in the kernel.
 
 The reference's hdrf mode (plugins/drf/drf.go:527-633) keeps a queue-path
 tree whose nodes carry weighted, saturation-scaled shares, re-sorted after
@@ -6,7 +6,10 @@ every placement. Here the tree is flattened to parent-pointer arrays once
 per session (host side) and the share recursion runs as per-depth segment
 reductions on device, so the round solver can re-rank jobs by the
 hierarchical comparator every round — the hdrf analog of the plain
-dominant-share re-rank in ops.solver.drf_state.
+dominant-share re-rank in ops.solver.drf_state — AND gate each round's
+growth per ancestor level so weighted trees converge to the reference's
+weighted split (drf.go's one-placement-then-resort loop, in round-sized
+bites).
 
 Contract notes:
 - the comparator walk (drf.go _compareQueues) compares (saturated,
@@ -24,6 +27,14 @@ Contract notes:
 - saturation (_resource_saturated, drf.go:93-109): a leaf saturates when
   some dimension's allocation covers its request, or it requests a
   dimension the cluster has exhausted (not "demanding").
+- internal-node shares use the reference's rescaling recursion
+  (drf.go updateHierarchicalShare): unsaturated children are scaled to
+  the minimum dominant share before summing into the parent, so
+  siblings dominating DISJOINT dimensions both register as the min —
+  the parent's share doesn't double-count orthogonal usage. The
+  progressive cap below reads these SCALED shares, which is what makes
+  it dimension-aware: two disjoint-dominant children can both fill past
+  naive raw-allocation parity because their scaled keys stay equal.
 """
 
 from __future__ import annotations
@@ -121,28 +132,25 @@ def build_hdrf(arr, queues, job_attrs, total_allocated) -> None:
         total_allocated.to_vector(vocab), np.float32)
 
 
-def hdrf_rank_state(a, rank):
-    """Device-side: returns hdrf_rank(jobres) -> [T] int32 dense ranks.
+def _hdrf_core(a, rank):
+    """Shared device-side state for the hierarchical rank and cap.
 
-    jobres [J,R] is the solve's own placements; leaf allocations are
-    a["job_drf_allocated"] + jobres. Shares recompute bottom-up by depth
-    level (children of depth-d nodes are exactly depth d+1), then jobs
-    sort by the per-level (saturated, share/weight) lexicographic key.
-
-    KNOWN DEVIATION (round-5 lever): the progressive-filling cap paired
-    with this rank is the plain LEAF-share cap (ops.solver.drf_state),
-    which converges uniform-dominant-resource hierarchies toward
-    egalitarian per-job splits instead of the weighted tree split the
-    host comparator reaches placement-by-placement. A hierarchy-aware
-    cap (gating each job's growth at every ancestor level against live
-    sibling subtree keys) fixes the uniform case but regresses
-    disjoint-dominant-resource rescaling (eng children on different
-    dims must BOTH fill past naive subtree parity); it needs to be
-    dimension-aware before it can ship. tests/test_e2e.py
-    TestExampleIntegrations encodes the current contract.
+    Returns (tree_state, rank_from, cap_from):
+    - tree_state(jobres) -> (share[H], sat[H]): the reference's bottom-up
+      weighted recursion (drf.go updateHierarchicalShare) over the live
+      allocations a["job_drf_allocated"] + jobres.
+    - rank_from(share, sat) -> (r_rank[T], job_pos[J]): jobs sorted by the
+      per-level (saturated, share/weight) lexicographic key, tasks
+      inheriting their job's position.
+    - cap_from(share, sat, share_full, job_pos, eligible) -> eligible'[T]:
+      the hierarchy-aware progressive-filling cap (see hdrf_state);
+      share_full is tree_state evaluated with every eligible increment
+      placed (the cap's linearization endpoint).
     """
     import jax
     import jax.numpy as jnp
+
+    from .solver import _segment_prefix
 
     T = a["task_rank"].shape[0]
     J = a["job_min"].shape[0]
@@ -156,9 +164,26 @@ def hdrf_rank_state(a, rank):
     job_leaf = a["hdrf_job_leaf"]
     ancestors = a["hdrf_ancestors"]
     total = a["drf_total"]
+    task_job = a["task_job"]
     rank = a["task_rank"] if rank is None else rank
-    first_rank = jnp.full((J,), T, jnp.int32).at[a["task_job"]].min(rank)
-    within_rank = rank - first_rank[a["task_job"]]
+    first_rank = jnp.full((J,), T, jnp.int32).at[task_job].min(rank)
+    within_rank = rank - first_rank[task_job]
+    BIG = jnp.int32(2**31 - 1)
+
+    prerank = a.get("job_drf_prerank")
+    if prerank is None:
+        prerank = jnp.zeros(J, jnp.int32)
+    # per-node prerank: leaves carry their job's, internal nodes neutral
+    pr_node = jnp.full((H,), BIG, jnp.int32).at[job_leaf].set(prerank)
+
+    # per-task increment in global dominant-share units (matches
+    # ops.solver.drf_state's incr_t; accounting uses task_req)
+    drf_total_c = jnp.maximum(total, 1e-9)
+    incr_t = jnp.max(
+        jnp.where(total[None, :] > 0.0,
+                  a["task_req"] / drf_total_c[None, :], 0.0), axis=1)
+    j_seg_start = jnp.concatenate(
+        [jnp.array([True]), task_job[1:] != task_job[:-1]])
 
     def share_of(alloc):  # [H,R] -> [H]
         s = jnp.where(total[None, :] > 0.0,
@@ -168,7 +193,7 @@ def hdrf_rank_state(a, rank):
 
     def tree_state(jobres):
         """(share[H], sat[H]) after the bottom-up weighted recursion."""
-        alloc = jnp.zeros((H, a["drf_total"].shape[0]), jnp.float32)
+        alloc = jnp.zeros((H, total.shape[0]), jnp.float32)
         alloc = alloc.at[job_leaf].add(a["job_drf_allocated"] + jobres)
         total_alloc = a["hdrf_total_allocated"] + jnp.sum(jobres, axis=0)
         demanding = total_alloc < total                       # [R]
@@ -200,9 +225,7 @@ def hdrf_rank_state(a, rank):
             sat = jnp.where(tgt, sat_p, sat)
         return share, sat
 
-    def hdrf_rank(jobres):
-        share, sat = tree_state(jobres)
-
+    def rank_from(share, sat):
         # per-level lexicographic job key: level 1 is most significant;
         # within a level saturation dominates share/weight
         # (drf.go _compareQueues). The pre-drf provider rank (priority/
@@ -214,14 +237,199 @@ def hdrf_rank_state(a, rank):
             anc_c = jnp.maximum(anc, 0)
             keys.append(jnp.where(ok, share[anc_c] / weight[anc_c], 0.0))
             keys.append(jnp.where(ok, sat[anc_c], False))
-        prerank = a.get("job_drf_prerank")
-        keys.append(prerank if prerank is not None
-                    else jnp.zeros(J, jnp.int32))
+        keys.append(prerank)
         order_j = jnp.lexsort(tuple(keys))
         job_pos = jnp.zeros(J, jnp.int32).at[order_j].set(
             jnp.arange(J, dtype=jnp.int32))
-        order_t = jnp.lexsort((within_rank, job_pos[a["task_job"]]))
-        return jnp.zeros(T, jnp.int32).at[order_t].set(
+        order_t = jnp.lexsort((within_rank, job_pos[task_job]))
+        r_rank = jnp.zeros(T, jnp.int32).at[order_t].set(
             jnp.arange(T, dtype=jnp.int32))
+        return r_rank, job_pos
+
+    def cap_from(share, sat, share_full, job_pos, eligible):
+        """Hierarchy-aware progressive-filling cap.
+
+        Per round, for every ancestor level (leaf-most first), a subtree
+        may grow its (scaled share)/weight key only to
+        (min competing sibling key) + step — the round-sized version of
+        the reference's pick-lowest-key-queue loop; a subtree already
+        past that mark waits, exactly like a queue the comparator ranks
+        behind its siblings. Details:
+
+        - keys come from the SCALED tree shares, so disjoint-dominant
+          siblings (whose scaled keys stay equal as both fill) are not
+          throttled against each other (the dimension-awareness a raw
+          subtree-allocation cap lacks).
+        - the allowed key growth converts to a budget in raw increment
+          units through a per-subtree linearization: key_full (the tree
+          re-evaluated with every eligible increment placed) bounds how
+          far this subtree's key can move, so a subtree whose raw fill
+          moves its scaled key slowly (disjoint-dominant children) gets
+          a proportionally LARGER raw budget. The mean slope
+          (key_full-key)/raw_total is <= 1/weight (scaling never
+          amplifies), which guarantees the min-key subtree's budget
+          admits at least its first task — per-round progress.
+        - step's floor is weight-proportional in share units
+          (weight/(8*competing_weight)), so sibling subtrees fill at
+          weight-proportional RATES and a saturated cluster lands on the
+          weighted split in ~8 rounds even without node contention.
+        - each level's budget is charged in live hierarchical job-rank
+          order (job_pos), so sibling subtrees alternate the way the
+          reference's per-placement re-sort does; within a job the
+          static order is the live order.
+        - levels refine eligibility leaf-most first, so a task blocked
+          at its queue level doesn't consume an upper subtree's budget.
+        - saturated nodes rank after unsaturated ones in the comparator
+          (drf.go:560-566); the cap analog blocks a subtree while an
+          unsaturated competing sibling exists. A leaf saturates only
+          when fully allocated or demanding an exhausted dimension —
+          both unplaceable — so the block cannot strand feasible work.
+          (Callers additionally prefilter never-fit tasks — see the
+          solver's placeable mask — so an infeasible min-key sibling
+          cannot hold its whole group's budget at zero.)
+        - leaf siblings compete within the best (lowest) prerank group
+          under their parent: with hierarchy on, the tree governs
+          cross-queue order and priority orders jobs within a queue, so
+          a high-priority job is not throttled against (or made to
+          yield headroom to) lower-priority sibling shares.
+        """
+        key = share / weight                                    # [H]
+        key_full = share_full / weight                          # [H]
+        still = eligible
+        max_incr = jnp.max(jnp.where(eligible, incr_t, 0.0))
+        # full (round-entry) backlog per job: the SAME quantity share_full
+        # was evaluated with, so grow/slope stays dimensionally consistent
+        contrib_full = jnp.where(eligible, incr_t, 0.0)
+        job_full = jax.ops.segment_sum(contrib_full, task_job,
+                                       num_segments=J)
+        for lvl in range(D - 1, -1, -1):
+            anc_j = ancestors[:, lvl]                           # [J]
+            present_j = anc_j >= 0
+            anc_jc = jnp.maximum(anc_j, 0)
+            # within-job cumulative eligible increment (static task order
+            # == live order within a job)
+            contrib = jnp.where(still, incr_t, 0.0)
+            within_cum = _segment_prefix(
+                contrib[:, None], j_seg_start)[:, 0] + contrib
+            job_incr = jax.ops.segment_sum(contrib, task_job,
+                                           num_segments=J)
+            still_job = job_incr > 0.0
+            elig_j = still_job & present_j
+            node_elig = jnp.zeros(H, dtype=bool).at[anc_jc].max(elig_j)
+            competing = node_elig & ~sat
+            # leaf prerank gate (see docstring): only the best-prerank
+            # eligible leaves of a parent set the pace
+            minpr_p = jax.ops.segment_min(
+                jnp.where(competing, pr_node, BIG), parent,
+                num_segments=H)
+            competing = competing & (~is_leaf
+                                     | (pr_node == minpr_p[parent]))
+            m_p = jax.ops.segment_min(
+                jnp.where(competing, key, jnp.inf), parent,
+                num_segments=H)
+            cws_p = jax.ops.segment_sum(
+                jnp.where(competing, weight, 0.0), parent, num_segments=H)
+            m_j = m_p[parent[anc_jc]]
+            cws_j = cws_p[parent[anc_jc]]
+            has_comp = jnp.isfinite(m_j)
+            w_j = weight[anc_jc]
+            step_j = jnp.maximum(max_incr / w_j,
+                                 1.0 / (8.0 * jnp.maximum(cws_j, 1e-9)))
+            grow_j = jnp.where(
+                has_comp,
+                jnp.maximum(m_j + step_j - key[anc_jc], 0.0), jnp.inf)
+            grow_j = jnp.where(present_j & sat[anc_jc] & has_comp,
+                               0.0, grow_j)
+            # allowed key growth -> raw-units budget via the subtree's
+            # mean slope over its whole ROUND-ENTRY backlog (the backlog
+            # share_full was evaluated with): budget = grow/slope =
+            # grow * full_total/denom, capped at full_total. Slope
+            # <= 1/weight (scaling never amplifies), so grow >= step >=
+            # max_incr/weight guarantees the min-key subtree's budget
+            # admits at least one task.
+            node_full = jnp.zeros(H, jnp.float32).at[anc_jc].add(
+                jnp.where(present_j, job_full, 0.0))
+            denom_j = key_full[anc_jc] - key[anc_jc]
+            full_j = node_full[anc_jc]
+            budget_j = jnp.where(
+                denom_j > 1e-12,
+                jnp.clip(grow_j / jnp.maximum(denom_j, 1e-12), 0.0, 1.0)
+                * full_j,
+                jnp.where(grow_j > 0.0, full_j, 0.0))           # [J]
+            # min-key floor: the comparator's lowest-key queue always
+            # places at least one task per re-sort in the reference; the
+            # slope bound alone cannot guarantee that here, because k
+            # same-dominant-dimension children rescaling to a rising min
+            # share amplify their parent's key growth up to k-fold, which
+            # can shave the step budget just under one task
+            is_min_j = (present_j & has_comp & ~sat[anc_jc]
+                        & (key[anc_jc]
+                           <= m_j + 1e-7 + 1e-5 * jnp.abs(m_j)))
+            budget_j = jnp.where(is_min_j,
+                                 jnp.maximum(budget_j, max_incr), budget_j)
+            # budget charged in live job-rank order: jobs under the same
+            # ancestor sorted by job_pos, exclusive prefix of their
+            # (post-refinement) eligible increments
+            sort_key = jnp.where(present_j,
+                                 anc_jc * (J + 1) + job_pos, BIG)
+            p_j = jnp.argsort(sort_key)
+            s_anc = anc_jc[p_j]
+            s_seg = jnp.concatenate(
+                [jnp.array([True]),
+                 (s_anc[1:] != s_anc[:-1])
+                 | (~present_j[p_j][1:] | ~present_j[p_j][:-1])])
+            s_incr = jnp.where(present_j[p_j], job_incr[p_j], 0.0)
+            s_base = _segment_prefix(s_incr[:, None], s_seg)[:, 0]
+            job_base = jnp.zeros(J, jnp.float32).at[p_j].set(s_base)
+            cum_t = job_base[task_job] + within_cum             # [T]
+            ok = cum_t <= budget_j[task_job] + 1e-6
+            still = still & (~present_j[task_job] | ok)
+        return still
+
+    return tree_state, rank_from, cap_from
+
+
+def hdrf_state(a, rank):
+    """Device-side: returns rank_and_cap(eligible, jobres) ->
+    (r_rank[T], eligible'[T]) — one tree recursion per round feeding both
+    the hierarchical re-rank and the progressive-filling cap.
+
+    This is the round solver's hdrf analog of ops.solver.drf_state's
+    (drf_rank, drf_cap) pair; parity vs the reference's
+    place-one-resort loop is fuzzed in tests/test_fairshare.py
+    (TestHDRFProgressiveParity).
+    """
+
+    import jax
+    import jax.numpy as jnp
+
+    tree_state, rank_from, cap_from = _hdrf_core(a, rank)
+    J = a["job_min"].shape[0]
+
+    def rank_and_cap(eligible, jobres):
+        share, sat = tree_state(jobres)
+        # second tree evaluation with every eligible increment placed:
+        # the cap's linearization endpoint (see cap_from)
+        pending = jax.ops.segment_sum(
+            a["task_req"] * eligible[:, None], a["task_job"],
+            num_segments=J)
+        share_full, _ = tree_state(jobres + pending)
+        r_rank, job_pos = rank_from(share, sat)
+        still = cap_from(share, sat, share_full, job_pos, eligible)
+        return r_rank, still
+
+    return rank_and_cap
+
+
+def hdrf_rank_state(a, rank):
+    """Device-side: returns hdrf_rank(jobres) -> [T] int32 dense ranks
+    (the re-rank alone, no cap — comparator parity tests and consumers
+    that manage their own eligibility)."""
+    tree_state, rank_from, _ = _hdrf_core(a, rank)
+
+    def hdrf_rank(jobres):
+        share, sat = tree_state(jobres)
+        r_rank, _ = rank_from(share, sat)
+        return r_rank
 
     return hdrf_rank

@@ -51,6 +51,7 @@ NEG = -1e30
 _THR_CPU = 10.0
 _THR_MEM = 1.0
 _THR_SCALAR = 10.0
+_REL_FIT_TOL = 5e-7  # mirrors ops.solver.REL_FIT_TOL (see its rationale)
 
 
 def _pick_tile(n: int, full_cap: int = 2048) -> int:
@@ -94,7 +95,11 @@ def _kernel(reqT_ref, elig_ref, sig_ref, availT_ref, usedT_ref, invT_ref,
         req_r = reqT_ref[r, :][:, None]                       # [bt,1]
         av_r = availT_ref[r, :][None, :]                      # [1,bn]
         thr = _THR_CPU if r == 0 else (_THR_MEM if r == 1 else _THR_SCALAR)
-        ok = (req_r < av_r + thr) | (req_r <= av_r)
+        # same expression order as ops.solver.le_fits (incl. the float32
+        # scale-aware REL_FIT_TOL term) so the fused path stays bitwise
+        # identical to the dense one
+        ok = (req_r < av_r + (thr + _REL_FIT_TOL * jnp.abs(av_r))) \
+            | (req_r <= av_r)
         if r >= 2:
             ok = ok | (req_r <= 10.0)
         feas = feas & ok

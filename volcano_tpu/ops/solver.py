@@ -41,6 +41,17 @@ import numpy as np
 NEG = jnp.float32(-1e30)
 BIG_KEY = jnp.int32(2**31 - 1)
 
+#: scale-aware fit tolerance (float32 ulp compensation): the reference
+#: compares in float64 with a 1-BYTE memory threshold
+#: (resource_info.go:70-72), but this kernel's idle accounting subtracts
+#: in float32, where one ulp at a 10-GiB node is ~1 KiB — an exact fit
+#: can drift a few hundred bytes below the request and strand the last
+#: placement the float64 reference makes. A few-ulp relative term keeps
+#: exact fits feasible at any magnitude; at milli-CPU magnitudes it is
+#: far below the 10-milli threshold, so only huge-magnitude dims
+#: (memory) see it, and at worst it over-admits by ~5e-7 of a node.
+REL_FIT_TOL = jnp.float32(5e-7)
+
 
 class SolveResult(NamedTuple):
     assigned: jnp.ndarray   # [T] int32 node index or -1
@@ -98,7 +109,8 @@ def le_fits(lhs, avail, thr, scalar_mask, ignore_req=None):
     solver, queue caps, and sharded admission all call this so a semantics
     tweak can't desynchronize them.
     """
-    dim_ok = (lhs < avail + thr) | (lhs <= avail)
+    dim_ok = (lhs < avail + (thr + REL_FIT_TOL * jnp.abs(avail))) \
+        | (lhs <= avail)
     req = lhs if ignore_req is None else ignore_req
     return jnp.all(dim_ok | (scalar_mask & (req <= 10.0)), axis=-1)
 
@@ -572,7 +584,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                       or per_node_cap != 0):
         use_fused = False  # fused path implements only the herd modes
     if use_fused:
-        from .pallas_kernels import pack_pars
+        from .pallas_kernels import fused_choice, pack_pars
         R_ = a["task_init_req"].shape[1]
         sig_i8 = sig_feas.astype(jnp.int8)
         inv_alloc = 1.0 / a["node_alloc"]
@@ -595,11 +607,13 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
     if use_drf_order:
         jobres0, drf_rank, drf_cap = drf_state(a, rank)
         if use_hdrf_order:
-            # hierarchical comparator replaces the plain dominant-share
-            # ranking; the progressive-filling cap stays the leaf-share
-            # one (see ops.hdrf.hdrf_rank_state's KNOWN DEVIATION note)
-            from .hdrf import hdrf_rank_state
-            drf_rank = hdrf_rank_state(a, rank)
+            # hierarchical mode: the comparator AND the progressive cap
+            # both come from the weighted tree (ops.hdrf.hdrf_state) —
+            # one tree recursion per round feeds the re-rank and the
+            # per-ancestor-level growth gate, so weighted hierarchies
+            # converge to the reference's weighted split
+            from .hdrf import hdrf_state
+            hdrf_rank_cap = hdrf_state(a, rank)
     else:
         jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
         drf_rank = drf_cap = None
@@ -626,9 +640,37 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
             eligible = (a["task_valid"] & (assigned < 0)
                         & ~excluded[a["task_job"]])
             # per-round admission priority: live DRF shares when active
+            used_now = a["node_used"] + (a["node_idle"] - idle)
+            feas0 = None
             if use_drf_order:
-                r_rank = drf_rank(jobres)
-                eligible = drf_cap(eligible, jobres)
+                if use_hdrf_order:
+                    # placeability prefilter: a task no node can take this
+                    # round must not hold its sibling group's min key or
+                    # pin its subtree's budget (the reference's queue loop
+                    # skips a queue whose job can't place and pops the
+                    # next — hard cap-blocking against an unplaceable
+                    # sibling would strand capacity instead). The dense
+                    # path reuses this round's feasibility matrix; the
+                    # fused path pays one extra kernel pass (hdrf only).
+                    pods_ok_v = npods < a["node_max_pods"]
+                    if use_fused:
+                        best_s0, _, _ = fused_choice(
+                            a["task_init_req"], avail, used_now,
+                            inv_alloc, node_static,
+                            eligible.astype(jnp.float32),
+                            pods_ok_v.astype(jnp.float32),
+                            sig_i8, fused_pars, score_families)
+                        placeable = best_s0 > NEG * 0.5
+                    else:
+                        feas0 = fits_matrix(a["task_init_req"], avail,
+                                            thr, scalar_mask) & sig_feas
+                        placeable = jnp.any(
+                            feas0 & pods_ok_v[None, :], axis=1)
+                    r_rank, eligible = hdrf_rank_cap(
+                        eligible & placeable, jobres)
+                else:
+                    r_rank = drf_rank(jobres)
+                    eligible = drf_cap(eligible, jobres)
             else:
                 r_rank = rank
             if use_queue_cap:
@@ -643,7 +685,6 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 eligible = eligible & _queue_cap_mask(
                     eligible, task_queue, a["task_req"], qrem, thr,
                     scalar_mask, qp, q_seg_start)
-            used_now = a["node_used"] + (a["node_idle"] - idle)
             if use_fused:
                 new_assign, debit, pod_inc = _admission_round_fused(
                     eligible, a, avail, used_now, sig_feas, sig_i8,
@@ -651,8 +692,9 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                     r_rank, thr, scalar_mask, npods, herd_mode,
                     score_families)
             else:
-                feas = fits_matrix(a["task_init_req"], avail, thr,
-                                   scalar_mask) & sig_feas
+                feas = feas0 if feas0 is not None else (
+                    fits_matrix(a["task_init_req"], avail, thr,
+                                scalar_mask) & sig_feas)
                 score = score_matrix(a["task_init_req"], avail, used_now,
                                      a["node_alloc"], score_params,
                                      score_families)

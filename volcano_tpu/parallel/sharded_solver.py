@@ -131,18 +131,24 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         if use_drf_order:
             jobres0, drf_rank, drf_cap = drf_state(a, rank)
             if use_hdrf_order:
-                from ..ops.hdrf import hdrf_rank_state
-                drf_rank = hdrf_rank_state(a, rank)
+                # replicated [H]/[J]/[T] math: every device runs the
+                # identical tree recursion + cap (ops/hdrf.py hdrf_state)
+                from ..ops.hdrf import hdrf_state
+                hdrf_rank_cap = hdrf_state(a, rank)
         else:
             jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
-        def choose(eligible, avail, idle, npods):
+        def choose(eligible, avail, idle, npods, feas0=None):
             """Global choice per task: local scoring + cross-device argmax,
             with the waterfall herd spread computed on gathered [N]
-            vectors."""
-            pods_ok = (npods < a["node_max_pods"])[None, :]
-            feas = (fits_matrix(a["task_init_req"], avail, thr, scalar_mask)
-                    & sig_feas & pods_ok & eligible[:, None])
+            vectors. feas0: optional precomputed fits & sig & pods mask
+            (the hdrf prefilter already paid for it this round)."""
+            if feas0 is None:
+                pods_ok = (npods < a["node_max_pods"])[None, :]
+                feas0 = (fits_matrix(a["task_init_req"], avail, thr,
+                                     scalar_mask)
+                         & sig_feas & pods_ok)
+            feas = feas0 & eligible[:, None]
             used_now = a["node_used"] + (a["node_idle"] - idle)
             score = score_matrix(a["task_init_req"], avail, used_now,
                                  a["node_alloc"], sp, score_families)
@@ -253,9 +259,26 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     else idle
                 eligible = (a["task_valid"] & (assigned < 0)
                             & ~excluded[a["task_job"]])
+                feas0 = None
                 if use_drf_order:
-                    r_rank = drf_rank(jobres)
-                    eligible = drf_cap(eligible, jobres)
+                    if use_hdrf_order:
+                        # placeability prefilter (see ops/solver.py): a
+                        # task no node in ANY shard can take must not
+                        # hold its sibling group's min key or budget.
+                        # feas0 is handed to choose() so the [T,N_loc]
+                        # matrix is built once per round.
+                        pods_ok_v = npods < a["node_max_pods"]
+                        feas0 = (fits_matrix(a["task_init_req"], avail,
+                                             thr, scalar_mask)
+                                 & sig_feas & pods_ok_v[None, :])
+                        placeable = jax.lax.psum(
+                            jnp.any(feas0, axis=1).astype(jnp.int32),
+                            "n") > 0
+                        r_rank, eligible = hdrf_rank_cap(
+                            eligible & placeable, jobres)
+                    else:
+                        r_rank = drf_rank(jobres)
+                        eligible = drf_cap(eligible, jobres)
                 else:
                     r_rank = rank
                 if use_queue_cap:
@@ -267,7 +290,7 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     eligible = eligible & _queue_cap_mask(
                         eligible, task_queue, a["task_req"], qrem, thr,
                         scalar_mask, qp, q_seg_start)
-                choice, feas = choose(eligible, avail, idle, npods)
+                choice, feas = choose(eligible, avail, idle, npods, feas0)
                 new_assign, debit, pod_inc = admit_local(
                     choice, feas, avail, npods, r_rank)
                 got = new_assign >= 0
