@@ -302,8 +302,24 @@ class AllocateAction(Action):
             from ..ops.solver import (
                 solve_allocate_delta, solve_allocate_packed2d,
             )
+            t1 = _time.perf_counter()
             fbuf, ibuf, layout = arr.packed()
+            timing["pack_ms"] = (_time.perf_counter() - t1) * 1e3
+            params = dc.params_device(params)
+            # flags snapshot for diagnostics/benchmarks that re-dispatch
+            # the same solve variant against the committed buffers
+            dc.last_solve_flags = dict(
+                layout=layout, herd_mode=herd, score_families=families,
+                use_queue_cap=use_queue_cap, use_drf_order=use_drf_order,
+                use_hdrf_order=use_hdrf_order,
+                work_conserving=work_conserving)
+            dc.last_params = params
+            t1 = _time.perf_counter()
             kind_, payload = dc.plan_delta(fbuf, ibuf, layout)
+            timing["delta_plan_ms"] = (_time.perf_counter() - t1) * 1e3
+            timing["delta_chunks"] = float(dc.last_shipped_chunks)
+            timing["delta_fused"] = float(kind_ == "fused")
+            t1 = _time.perf_counter()
             if kind_ == "updated":
                 f2d, i2d = payload
                 res = solve_allocate_packed2d(
@@ -328,6 +344,7 @@ class AllocateAction(Action):
                     dc.reset()
                     raise
                 dc.commit(new_f, new_i)
+            timing["dispatch_ms"] = (_time.perf_counter() - t1) * 1e3
         else:
             res = solve_allocate(
                 arr.device_dict(), params, herd_mode=herd,
@@ -340,11 +357,13 @@ class AllocateAction(Action):
             # remote chip is bandwidth-poor, so the result wire format
             # matters (the sidecar path already returned host arrays)
             from ..ops.solver import COMPACT_KIND_SHIFT, decode_compact
+            t1 = _time.perf_counter()
             if arr.N <= (1 << COMPACT_KIND_SHIFT):
                 assigned, kind = decode_compact(res.compact)
             else:  # >16k nodes: node index overflows the int16 packing
                 assigned = np.asarray(res.assigned)
                 kind = np.asarray(res.kind)
+            timing["readback_ms"] = (_time.perf_counter() - t1) * 1e3
         timing["solve_ms"] = (_time.perf_counter() - t0) * 1e3
         t0 = _time.perf_counter()
 

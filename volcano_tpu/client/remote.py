@@ -49,13 +49,40 @@ class RemoteClusterStore:
 
     def __init__(self, address: str, connect_timeout: float = 5.0,
                  token: Optional[str] = None,
-                 on_watch_failure: Optional[Callable[[], None]] = None):
+                 on_watch_failure: Optional[Callable[[], None]] = None,
+                 tls_ca: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.connect_timeout = connect_timeout
         self.token = token if token is not None \
             else os.environ.get("VOLCANO_STORE_TOKEN", "")
+        # TLS to a StoreServer serving it (see its docstring): tls_ca is
+        # the CA bundle the SERVER cert must verify against (also
+        # $VOLCANO_STORE_CA); tls_cert/tls_key present a client
+        # certificate for mTLS servers
+        self.tls_ca = tls_ca if tls_ca is not None \
+            else os.environ.get("VOLCANO_STORE_CA") or None
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
+        self._ssl_ctx = None
+        if self.tls_ca or self.tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False  # cluster-internal addr, CA-pinned
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            if self.tls_ca:
+                ctx.load_verify_locations(self.tls_ca)
+            else:
+                # client-cert-only config: verify the server against the
+                # system trust store instead of an empty one
+                ctx.load_default_certs()
+            if self.tls_cert:
+                ctx.load_cert_chain(self.tls_cert, self.tls_key)
+            self._ssl_ctx = ctx
         self.on_watch_failure = on_watch_failure
         self.watch_failed = False
         self._lock = threading.RLock()   # local mirror/listener lock
@@ -70,6 +97,9 @@ class RemoteClusterStore:
     def _connect(self) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.connect_timeout)
+        if self._ssl_ctx is not None:
+            sock = self._ssl_ctx.wrap_socket(
+                sock, server_hostname=self.host)
         sock.settimeout(None)
         sock.sendall(MAGIC)
         if self.token:
@@ -208,7 +238,13 @@ class RemoteClusterStore:
             if stream == "synced":
                 break
             if stream == "event":
-                self._deliver(listener, msg)
+                # under self._lock like the reader threads: during the
+                # cache's sequential subscriptions (nodes, then pods, ...)
+                # a LIVE event on an earlier kind's stream must not mutate
+                # the mirror concurrently with a later kind's replay —
+                # cache handlers rely on the store serializing dispatch
+                with self._lock:
+                    self._deliver(listener, msg)
 
         def reader():
             try:

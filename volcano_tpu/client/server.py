@@ -40,6 +40,8 @@ log = logging.getLogger(__name__)
 
 MAGIC = b"VCS1"
 MAX_FRAME_BYTES = 64 << 20  # a 10k-pod wave of Jobs is ~10 MB of JSON
+WATCH_QUEUE_MAX = 65536     # pending events before a slow watcher drops
+WATCH_SEND_TIMEOUT_S = 30.0
 
 _ERRORS = {
     "ConflictError": ConflictError,
@@ -83,6 +85,16 @@ class _Handler(socketserver.BaseRequestHandler):
         sock = self.request
         store: ClusterStore = self.server.store  # type: ignore[attr-defined]
         token = self.server.token  # type: ignore[attr-defined]
+        ssl_ctx = self.server.ssl_ctx  # type: ignore[attr-defined]
+        if ssl_ctx is not None:
+            # per-connection handshake in THIS handler thread, so a slow
+            # (or hostile) handshaker never blocks the accept loop
+            try:
+                sock = ssl_ctx.wrap_socket(sock, server_side=True)
+            except (OSError, ValueError) as e:
+                log.warning("store TLS handshake failed: %s", e)
+                return
+            self.request = sock
         self.server.active.add(sock)  # type: ignore[attr-defined]
         try:
             if recv_exact(sock, 4) != MAGIC:
@@ -168,13 +180,27 @@ class _Handler(socketserver.BaseRequestHandler):
                               "message": f"unknown watch kinds {bad}"})
             return
         replay = bool(req.get("replay", True))
-        events: "queue.Queue" = queue.Queue()
+        # bounded queue + send timeout: a peer that stalls without closing
+        # (TCP zero window) otherwise blocks the writer in sendall forever
+        # while the listeners keep enqueueing — unbounded memory per stuck
+        # watcher. On overflow the watcher is dropped (client-go's watch
+        # buffers terminate slow watchers the same way); the client sees
+        # the close and treats it as a broken stream (crash-only resync).
+        events: "queue.Queue" = queue.Queue(maxsize=WATCH_QUEUE_MAX)
+        overflowed = threading.Event()
+        sock.settimeout(WATCH_SEND_TIMEOUT_S)
 
         def listener_for(kind):
             def listener(event, obj, old):
-                events.put({"stream": "event", "kind": kind,
-                            "event": event, "obj": encode(obj),
-                            "old": encode(old) if old is not None else None})
+                if overflowed.is_set():
+                    return  # watcher already condemned: stop buffering
+                try:
+                    events.put_nowait(
+                        {"stream": "event", "kind": kind,
+                         "event": event, "obj": encode(obj),
+                         "old": encode(old) if old is not None else None})
+                except queue.Full:
+                    overflowed.set()
             return listener
 
         listeners = []
@@ -186,8 +212,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 listener = listener_for(kind)
                 listeners.append((kind, listener))
                 store.watch(kind, listener, replay=replay)
-            events.put({"stream": "synced"})
-            while True:
+            try:
+                # put_nowait like the listeners: a replay bigger than the
+                # whole queue has already condemned this watcher, and a
+                # blocking put would deadlock (nothing drains yet)
+                events.put_nowait({"stream": "synced"})
+            except queue.Full:
+                overflowed.set()
+            while not overflowed.is_set():
                 try:
                     payload = events.get(timeout=10.0)
                 except queue.Empty:
@@ -196,6 +228,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     # stay subscribed forever
                     payload = {"stream": "heartbeat"}
                 send_frame(sock, payload)
+            log.warning("watch stream overflowed %d events; dropping the "
+                        "slow watcher", WATCH_QUEUE_MAX)
         except (ConnectionError, OSError, ValueError):
             pass  # peer went away
         finally:
@@ -210,17 +244,44 @@ class StoreServer:
     auth frame carrying it (the analog of the API server's bearer-token
     check). REQUIRED for non-loopback binds: the store holds Secrets and
     the leader-election lease; standalone refuses to expose it
-    unauthenticated."""
+    unauthenticated.
+
+    ``tls_cert``/``tls_key``: serve TLS — the reference's equivalent seam
+    (the k8s API server) is always TLS, and without it the token and
+    every payload (ssh-keypair Secrets, the HA lease) cross the network
+    in clear. ``tls_client_ca`` additionally requires client
+    certificates (mTLS). Non-loopback deployments should set these (or
+    run inside a network layer that encrypts, e.g. a service mesh);
+    webhooks.server.generate_self_signed_cert bootstraps a dev pair."""
 
     def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
-                 port: int = 0, token: Optional[str] = None):
+                 port: int = 0, token: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 tls_client_ca: Optional[str] = None):
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
+        ssl_ctx = None
+        if tls_cert and tls_key:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(tls_cert, tls_key)
+            if tls_client_ca:
+                ssl_ctx.verify_mode = ssl.CERT_REQUIRED
+                ssl_ctx.load_verify_locations(tls_client_ca)
+        elif tls_cert or tls_key or tls_client_ca:
+            # a half-configured pair must not silently serve plaintext
+            raise ValueError(
+                "store TLS needs BOTH tls_cert and tls_key "
+                "(tls_client_ca additionally needs them)")
+
         self._server = _Server((host, port), _Handler)
         self._server.store = store  # type: ignore[attr-defined]
         self._server.token = token or ""  # type: ignore[attr-defined]
+        self._server.ssl_ctx = ssl_ctx  # type: ignore[attr-defined]
         # live connection sockets, so stop() drops watch streams too
         # (daemon handler threads outlive server_close otherwise and
         # clients would never learn the server is gone)

@@ -212,3 +212,24 @@ class PackedDeviceCache:
         """Store the buffers returned by solve_allocate_delta (the inputs
         were donated and are now invalid)."""
         self._dev_f, self._dev_i = f2d, i2d
+
+    # ------------------------------------------------------------------
+    # device-resident score params: the per-session params dict is a few
+    # small arrays ([N] node_static dominates, ~8 KB at 2k nodes) that
+    # almost never change between cycles — re-uploading them every
+    # dispatch wastes tunnel bandwidth on the critical path. Cache the
+    # device copies and re-put only when the content bytes change.
+    # ------------------------------------------------------------------
+
+    def params_device(self, params: dict) -> dict:
+        import jax
+
+        blob = b"".join(
+            k.encode() + np.asarray(v).tobytes()
+            for k, v in sorted(params.items()))
+        if blob == getattr(self, "_params_blob", None):
+            return self._params_dev
+        self._params_dev = {k: jax.device_put(np.asarray(v))
+                            for k, v in params.items()}
+        self._params_blob = blob
+        return self._params_dev

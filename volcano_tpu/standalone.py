@@ -68,8 +68,30 @@ class Standalone:
                 raise ValueError(
                     f"--serve-store on non-loopback {host!r} requires a "
                     "shared token (set VOLCANO_STORE_TOKEN)")
+            tls_cert = os.environ.get("VOLCANO_STORE_TLS_CERT") or None
+            tls_key = os.environ.get("VOLCANO_STORE_TLS_KEY") or None
+            tls_ca = os.environ.get("VOLCANO_STORE_CLIENT_CA") or None
+            if (tls_cert is None) != (tls_key is None):
+                raise ValueError(
+                    "VOLCANO_STORE_TLS_CERT and VOLCANO_STORE_TLS_KEY "
+                    "must be set together")
+            if not (tls_cert and tls_key) and host not in (
+                    "127.0.0.1", "localhost", "::1"):
+                # plaintext beyond loopback leaks the token and every
+                # Secret to the network path; allow it only when the
+                # operator explicitly claims link-layer encryption
+                if os.environ.get(
+                        "VOLCANO_STORE_ALLOW_PLAINTEXT") != "1":
+                    raise ValueError(
+                        f"--serve-store on non-loopback {host!r} without "
+                        "TLS (set VOLCANO_STORE_TLS_CERT/"
+                        "VOLCANO_STORE_TLS_KEY, or acknowledge an "
+                        "encrypted network layer with "
+                        "VOLCANO_STORE_ALLOW_PLAINTEXT=1)")
             self.store_server = StoreServer(
-                self.store, host, int(port), token=token).start()
+                self.store, host, int(port), token=token,
+                tls_cert=tls_cert, tls_key=tls_key,
+                tls_client_ca=tls_ca).start()
         self.webhook_server = None
         if serve_webhooks_tls:
             from .webhooks import serve_webhooks
