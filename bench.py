@@ -60,6 +60,9 @@ def rtt_probe(n: int = 3) -> float:
 
 
 def spread_fields(prefix: str, samples) -> dict:
+    """p10/p90/std for a sample set — the artifact's only p90 source (the
+    explicit *_p90_ms fields were dropped so one statistic can't ship
+    under two names)."""
     a = np.asarray(samples, np.float64)
     return {
         f"{prefix}_p10_ms": round(float(np.percentile(a, 10)), 2),
@@ -287,7 +290,6 @@ def headline():
     p50 = float(np.percentile(lat, 50))
     return {
         "p50_ms": round(p50, 2),
-        "p90_ms": round(float(np.percentile(lat, 90)), 2),
         **spread_fields("lat", lat),
         "rtt_floor_ms": round(rtt, 2),
         "rtt_p10_ms": round(float(np.percentile(rtts, 10)), 2),
@@ -446,7 +448,6 @@ def full_cycle():
         "burst_bound": burst_bound,
         "burst_decomp": burst_timing,
         "steady_p50_ms": round(p50, 2),
-        "steady_p90_ms": round(float(np.percentile(lat, 90)), 2),
         **spread_fields("steady", lat),
         "steady_host_p50_ms": round(host_p50, 2),
         **spread_fields("steady_host", host_ms),

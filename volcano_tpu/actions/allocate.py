@@ -376,6 +376,25 @@ class AllocateAction(Action):
         kind = kind.tolist()
         nodes_list = arr.nodes_list
         idx = 0
+        # bulk-commit window: committed statements queue their cache-side
+        # binds + allocate events; ONE flush applies them with full-width
+        # node grouping (per-job commits degrade to 1-task node groups
+        # when gangs spread across nodes — see Statement.commit)
+        from ..framework.statement import begin_bulk_commit, \
+            flush_bulk_commit
+        acc = begin_bulk_commit(ssn)
+        try:
+            self._replay(ssn, arr, job_order, assigned, kind, acc)
+        finally:
+            # exception-safe: jobs already committed into the window MUST
+            # still get their cache binds + events even if a later job's
+            # replay blows up (per-statement commits applied them eagerly)
+            flush_bulk_commit(ssn, acc)
+        timing["replay_ms"] = (_time.perf_counter() - t0) * 1e3
+
+    def _replay(self, ssn, arr, job_order, assigned, kind, acc) -> None:
+        nodes_list = arr.nodes_list
+        idx = 0
         for job, tasks in job_order:
             stmt = ssn.statement(defer_events=True)
             pairs = []
@@ -410,7 +429,6 @@ class AllocateAction(Action):
                 stmt.commit()
             else:
                 stmt.discard()
-        timing["replay_ms"] = (_time.perf_counter() - t0) * 1e3
 
     @staticmethod
     def _fill_queue_arrays(arr, queue_opts, ssn) -> None:

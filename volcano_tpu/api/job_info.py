@@ -84,7 +84,7 @@ class TaskInfo:
 
     __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
                  "node_name", "status", "priority", "volume_ready", "pod",
-                 "sig_cache")
+                 "sig_cache", "key")
 
     def __init__(self, pod):
         self.uid = pod.uid
@@ -99,6 +99,10 @@ class TaskInfo:
         self.resreq = get_pod_resource_without_init_containers(pod)
         self.init_resreq = get_pod_resource_request(pod)
         self.sig_cache = None  # memoized predicate signature (ops.arrays)
+        # plain attribute, not a property: pod identity is immutable and
+        # the replay/bind waves read key several times per task — the
+        # f-string + descriptor cost was measurable at a 10k-task burst
+        self.key = f"{self.namespace}/{self.name}"
 
     def clone(self) -> "TaskInfo":
         t = TaskInfo.__new__(TaskInfo)
@@ -118,11 +122,8 @@ class TaskInfo:
         t.resreq = self.resreq
         t.init_resreq = self.init_resreq
         t.sig_cache = self.sig_cache
+        t.key = self.key
         return t
-
-    @property
-    def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
 
     def __repr__(self) -> str:
         return (f"Task({self.namespace}/{self.name} job={self.job} "

@@ -42,6 +42,7 @@ MAGIC = b"VCS1"
 MAX_FRAME_BYTES = 64 << 20  # a 10k-pod wave of Jobs is ~10 MB of JSON
 WATCH_QUEUE_MAX = 65536     # pending events before a slow watcher drops
 WATCH_SEND_TIMEOUT_S = 30.0
+TLS_HANDSHAKE_TIMEOUT_S = 10.0
 
 _ERRORS = {
     "ConflictError": ConflictError,
@@ -86,16 +87,25 @@ class _Handler(socketserver.BaseRequestHandler):
         store: ClusterStore = self.server.store  # type: ignore[attr-defined]
         token = self.server.token  # type: ignore[attr-defined]
         ssl_ctx = self.server.ssl_ctx  # type: ignore[attr-defined]
+        # register the RAW socket first so stop() can always unblock this
+        # thread, and bound the handshake: a peer that connects and goes
+        # silent must not pin a handler thread forever
+        self.server.active.add(sock)  # type: ignore[attr-defined]
         if ssl_ctx is not None:
             # per-connection handshake in THIS handler thread, so a slow
             # (or hostile) handshaker never blocks the accept loop
+            raw = sock
             try:
+                sock.settimeout(TLS_HANDSHAKE_TIMEOUT_S)
                 sock = ssl_ctx.wrap_socket(sock, server_side=True)
+                sock.settimeout(None)
             except (OSError, ValueError) as e:
                 log.warning("store TLS handshake failed: %s", e)
+                self.server.active.discard(raw)
                 return
             self.request = sock
-        self.server.active.add(sock)  # type: ignore[attr-defined]
+            self.server.active.discard(raw)
+            self.server.active.add(sock)  # type: ignore[attr-defined]
         try:
             if recv_exact(sock, 4) != MAGIC:
                 return

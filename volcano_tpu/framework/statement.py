@@ -10,8 +10,7 @@ from __future__ import annotations
 
 import enum
 import logging
-from dataclasses import dataclass
-from typing import List
+from typing import List, Tuple
 
 from ..api import Resource, TaskInfo, TaskStatus
 
@@ -24,11 +23,9 @@ class Op(enum.Enum):
     ALLOCATE = "allocate"
 
 
-@dataclass
-class _Operation:
-    name: Op
-    task: TaskInfo
-    reason: str = ""
+#: operation log entries are plain (op, task, reason) tuples — a 10k-task
+#: replay appends one per task, and dataclass construction was measurable
+_Operation = Tuple[Op, TaskInfo, str]
 
 
 class Statement:
@@ -58,7 +55,7 @@ class Statement:
         if node is not None:
             node.update_task(reclaimee)
         self.ssn._fire_deallocate(reclaimee)
-        self.operations.append(_Operation(Op.EVICT, reclaimee, reason))
+        self.operations.append((Op.EVICT, reclaimee, reason))
 
     def _commit_evict(self, reclaimee: TaskInfo, reason: str) -> None:
         try:
@@ -88,7 +85,7 @@ class Statement:
         if node is not None:
             node.add_task(task)
         self.ssn._fire_allocate(task)
-        self.operations.append(_Operation(Op.PIPELINE, task))
+        self.operations.append((Op.PIPELINE, task, ""))
 
     def _unpipeline(self, task: TaskInfo) -> None:
         job = self.ssn.jobs.get(task.job)
@@ -113,7 +110,7 @@ class Statement:
             node.add_task(task)
         if not self.defer_events:
             self.ssn._fire_allocate(task)
-        self.operations.append(_Operation(Op.ALLOCATE, task))
+        self.operations.append((Op.ALLOCATE, task, "")) 
 
     def allocate_bulk(self, pairs) -> list:
         """allocate() over a whole assignment wave ([(task, hostname)]) in
@@ -220,7 +217,7 @@ class Statement:
                 for task in tasks:
                     ssn._fire_allocate(task)
             for task in tasks:
-                ops.append(_Operation(Op.ALLOCATE, task))
+                ops.append((Op.ALLOCATE, task, ""))
         for task, hostname in slow:
             try:
                 # volumes were already assumed; re-assuming is idempotent
@@ -239,36 +236,39 @@ class Statement:
             raise
 
     def _unallocate(self, task: TaskInfo, fired: bool = True) -> None:
-        revert = getattr(self.ssn.cache, "revert_volumes", None)
-        if revert is not None:
-            revert(task)  # drop the AllocateVolumes assumption
-        job = self.ssn.jobs.get(task.job)
-        if job is not None:
-            job.update_task_status(task, TaskStatus.PENDING)
-        node = self.ssn.nodes.get(task.node_name)
-        if node is not None:
-            node.remove_task(task)
-        task.node_name = ""
-        if fired:
-            self.ssn._fire_deallocate(task)
+        _undo_allocate(self.ssn, task, fired)
 
     # -- transaction boundary ----------------------------------------------
 
     def commit(self) -> None:
         """Apply side effects (statement.go:370-388)."""
+        acc = getattr(self.ssn, "_bulk_commit_acc", None)
+        if acc is not None and self.defer_events and self.operations \
+                and getattr(self.ssn.cache, "bind_batch", None) is not None \
+                and all(name is Op.ALLOCATE
+                        for name, _, _ in self.operations):
+            # bulk-commit window (the solver replay): defer this
+            # statement's cache-side effects and allocate events to ONE
+            # end-of-replay wave (flush_bulk_commit). Per-job commits
+            # produce node groups of ~1 task when a job's gang spreads
+            # across nodes, degrading every bulk helper to per-task work;
+            # the merged wave re-groups the whole replay by node.
+            acc.extend(task for _, task, _ in self.operations)
+            self.operations = []
+            return
         if self.defer_events:
             self.ssn._fire_allocate_batch(
-                [op.task for op in self.operations
-                 if op.name == Op.ALLOCATE])
+                [task for name, task, _ in self.operations
+                 if name is Op.ALLOCATE])
         bind_batch = getattr(self.ssn.cache, "bind_batch", None)
         if bind_batch is not None and len(self.operations) > 1 and all(
-                op.name == Op.ALLOCATE for op in self.operations):
+                name is Op.ALLOCATE for name, _, _ in self.operations):
             # pure-allocate statement (the solver replay shape): volumes
             # bind as one wave, then ONE batched cache bind — identical
             # cache state and failure handling to the per-op loop, without
             # its per-task dispatch cost
             cache = self.ssn.cache
-            tasks = [op.task for op in self.operations]
+            tasks = [task for _, task, _ in self.operations]
             vb_batch = getattr(cache, "bind_volumes_batch", None)
             if vb_batch is not None:
                 vol_failures = vb_batch(tasks)
@@ -291,12 +291,12 @@ class Statement:
                 self._unallocate(task)
             self.operations = []
             return
-        for op in self.operations:
+        for name, task, reason in self.operations:
             try:
-                if op.name == Op.EVICT:
-                    self._commit_evict(op.task, op.reason)
-                elif op.name == Op.ALLOCATE:
-                    self._commit_allocate(op.task)
+                if name is Op.EVICT:
+                    self._commit_evict(task, reason)
+                elif name is Op.ALLOCATE:
+                    self._commit_allocate(task)
                 # Pipeline has no cache side effect: the promise lives in
                 # session/PodGroup state until resources actually free.
             except Exception:
@@ -305,13 +305,92 @@ class Statement:
 
     def discard(self) -> None:
         """Reverse-order undo (statement.go:345-367)."""
-        for op in reversed(self.operations):
-            if op.name == Op.EVICT:
-                self._unevict(op.task)
-            elif op.name == Op.PIPELINE:
-                self._unpipeline(op.task)
-            elif op.name == Op.ALLOCATE:
+        # a discarded statement must leave nothing in the bulk-commit
+        # window (its ops were never accumulated — commit() is the only
+        # writer — so plain reverse-undo below is complete)
+        for name, task, _ in reversed(self.operations):
+            if name is Op.EVICT:
+                self._unevict(task)
+            elif name is Op.PIPELINE:
+                self._unpipeline(task)
+            elif name is Op.ALLOCATE:
                 # deferred mode never fired the allocate event, so the
                 # undo must not fire the deallocate one
-                self._unallocate(op.task, fired=not self.defer_events)
+                self._unallocate(task, fired=not self.defer_events)
         self.operations = []
+
+
+def _undo_allocate(ssn, task: TaskInfo, fired: bool = True) -> None:
+    """Reverse one session-side allocate (shared by Statement._unallocate
+    and the bulk-commit flush, which outlives its statements)."""
+    revert = getattr(ssn.cache, "revert_volumes", None)
+    if revert is not None:
+        revert(task)  # drop the AllocateVolumes assumption
+    job = ssn.jobs.get(task.job)
+    if job is not None:
+        job.update_task_status(task, TaskStatus.PENDING)
+    node = ssn.nodes.get(task.node_name)
+    if node is not None:
+        node.remove_task(task)
+    task.node_name = ""
+    if fired:
+        ssn._fire_deallocate(task)
+
+
+def begin_bulk_commit(ssn) -> list:
+    """Open a bulk-commit window on the session: subsequent pure-allocate
+    deferred-event statements queue their tasks here instead of paying a
+    cache bind wave each (see Statement.commit). Caller MUST pair with
+    flush_bulk_commit."""
+    acc: list = []
+    ssn._bulk_commit_acc = acc
+    return acc
+
+
+def flush_bulk_commit(ssn, acc: list) -> None:
+    """Close the window and apply every queued statement's side effects as
+    one wave: a single allocate-event batch, one volume bind wave, one
+    cache bind_batch over the WHOLE replay (node groups re-form at full
+    width instead of per job). Cache state and failure semantics are
+    identical to per-statement commits — a task whose cache-side bind
+    fails is unallocated session-side exactly as Statement.commit would."""
+    ssn._bulk_commit_acc = None
+    if not acc:
+        return
+    ssn._fire_allocate_batch(acc)
+    cache = ssn.cache
+    tasks = acc
+    vb_batch = getattr(cache, "bind_volumes_batch", None)
+    if vb_batch is not None:
+        vol_failures = vb_batch(tasks)
+    else:
+        vol_failures = []
+        for task in tasks:
+            try:
+                cache.bind_volumes(task)
+            except Exception as e:  # noqa: BLE001
+                vol_failures.append((task, e))
+    if vol_failures:
+        failed = {id(t) for t, _ in vol_failures}
+        tasks = [t for t in tasks if id(t) not in failed]
+        for task, exc in vol_failures:
+            log.error("commit bind_volumes failed for %s: %s",
+                      task.key, exc)
+            _undo_allocate(ssn, task, fired=False)
+            ssn._fire_deallocate(task)
+    # Statement.commit only queues into the window when the cache HAS
+    # bind_batch; the guard here keeps the flush total anyway
+    bind_batch = getattr(cache, "bind_batch", None)
+    if bind_batch is not None:
+        failures = bind_batch(tasks)
+    else:
+        failures = []
+        for task in tasks:
+            try:
+                cache.bind(task, task.node_name)
+            except Exception as e:  # noqa: BLE001
+                failures.append((task, e))
+    for task, exc in failures:
+        log.error("commit bind failed for %s: %s", task.key, exc)
+        _undo_allocate(ssn, task, fired=False)
+        ssn._fire_deallocate(task)
