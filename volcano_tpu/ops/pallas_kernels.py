@@ -231,6 +231,20 @@ def fused_choice(init_req, avail, used_now, inv_alloc, node_static,
     return best_s[0], best_i[0], node_max[0]
 
 
+def fused_setup(a, score_params, R: int):
+    """The fused path's per-solve prelude, shared by the single-device and
+    sharded solvers so their parity-critical inputs cannot diverge:
+    (sig_i8, inv_alloc, fused_pars, node_static). `a` needs sig_feas
+    pre-composed ([T,N] bool) and node_alloc."""
+    import jax.numpy as jnp
+
+    sig_i8 = a["sig_feas"].astype(jnp.int8)
+    inv_alloc = 1.0 / a["node_alloc"]
+    fused_pars = pack_pars(score_params, R)
+    node_static = jnp.asarray(score_params["node_static"], jnp.float32)
+    return sig_i8, inv_alloc, fused_pars, node_static
+
+
 def pack_pars(params, R: int):
     """Build the kernel's flat parameter vector from the solver's score
     params dict (device-friendly: one tiny array instead of many

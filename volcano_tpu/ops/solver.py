@@ -584,12 +584,10 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                       or per_node_cap != 0):
         use_fused = False  # fused path implements only the herd modes
     if use_fused:
-        from .pallas_kernels import fused_choice, pack_pars
-        R_ = a["task_init_req"].shape[1]
-        sig_i8 = sig_feas.astype(jnp.int8)
-        inv_alloc = 1.0 / a["node_alloc"]
-        fused_pars = pack_pars(score_params, R_)
-        node_static = jnp.asarray(score_params["node_static"], jnp.float32)
+        from .pallas_kernels import fused_choice, fused_setup
+        sig_i8, inv_alloc, fused_pars, node_static = fused_setup(
+            {"sig_feas": sig_feas, "node_alloc": a["node_alloc"]},
+            score_params, a["task_init_req"].shape[1])
 
     if use_queue_cap:
         total = jnp.sum(

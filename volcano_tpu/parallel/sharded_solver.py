@@ -55,7 +55,7 @@ def make_mesh(devices=None, axis: str = "n") -> Mesh:
                                              "score_families",
                                              "use_queue_cap",
                                              "use_drf_order",
-                                             "use_hdrf_order"))
+                                             "use_hdrf_order", "fused"))
 def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            score_params: Dict[str, jnp.ndarray],
                            mesh: Mesh,
@@ -65,7 +65,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                            score_families: Tuple[str, ...] = ("binpack",),
                            use_queue_cap: bool = False,
                            use_drf_order: bool = False,
-                           use_hdrf_order: bool = False) -> SolveResult:
+                           use_hdrf_order: bool = False,
+                           fused: str = "auto") -> SolveResult:
     a = arrays
     T = a["task_init_req"].shape[0]
     N = a["node_idle"].shape[0]
@@ -76,6 +77,15 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
     scalar_mask = a["scalar_dim_mask"]
     counts_ready = a["task_counts_ready"].astype(jnp.int32)
     rank = a["task_rank"]
+    # fused pallas choice kernel PER SHARD (ops/pallas_kernels.py): each
+    # device's [T, N/D] feasibility/score/argmax pass runs in one VMEM
+    # kernel; only the [T]/[N/D] reductions cross the ICI. Same gate as
+    # the single-device solver, applied to the SHARD's node width.
+    from ..ops.pallas_kernels import fused_choice_auto
+    use_fused = fused == "on" or (
+        fused == "auto" and jax.default_backend() == "tpu"
+        and fused_choice_auto(T, N // D)
+        and herd_mode in ("pack", "spread"))
 
     in_specs = {
         "task_init_req": P(), "task_req": P(), "task_job": P(),
@@ -116,6 +126,11 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         n_loc = a["node_idle"].shape[0]
         my_base = axis_idx * n_loc
         sig_feas = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
+        if use_fused:
+            from ..ops.pallas_kernels import fused_choice, fused_setup
+            sig_i8, inv_alloc, fused_pars, node_static = fused_setup(
+                {"sig_feas": sig_feas, "node_alloc": a["node_alloc"]},
+                sp, a["task_init_req"].shape[1])
 
         if use_queue_cap:
             total = jax.lax.psum(
@@ -138,25 +153,52 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         else:
             jobres0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
+        def feas_at(eligible, avail, npods, t_loc, mine):
+            """Feasibility of (task, local node t_loc[task]) for this
+            shard — the pointwise re-derivation the fused path uses in
+            place of materializing the [T, N_loc] matrix."""
+            av = avail[jnp.clip(t_loc, 0, n_loc - 1)]
+            fit = le_fits(a["task_init_req"], av, thr, scalar_mask)
+            sig = jnp.take_along_axis(
+                sig_feas, jnp.clip(t_loc, 0, n_loc - 1)[:, None],
+                axis=1)[:, 0]
+            pods = (npods < a["node_max_pods"])[
+                jnp.clip(t_loc, 0, n_loc - 1)]
+            return fit & sig & pods & eligible & mine
+
         def choose(eligible, avail, idle, npods, feas0=None):
             """Global choice per task: local scoring + cross-device argmax,
             with the waterfall herd spread computed on gathered [N]
             vectors. feas0: optional precomputed fits & sig & pods mask
-            (the hdrf prefilter already paid for it this round)."""
-            if feas0 is None:
-                pods_ok = (npods < a["node_max_pods"])[None, :]
-                feas0 = (fits_matrix(a["task_init_req"], avail, thr,
-                                     scalar_mask)
-                         & sig_feas & pods_ok)
-            feas = feas0 & eligible[:, None]
+            (the hdrf prefilter already paid for it this round). In fused
+            mode the local [T, N_loc] pass runs in the pallas kernel and
+            target feasibility re-derives pointwise."""
             used_now = a["node_used"] + (a["node_idle"] - idle)
-            score = score_matrix(a["task_init_req"], avail, used_now,
-                                 a["node_alloc"], sp, score_families)
-            masked = jnp.where(feas, score, NEG)
+            if use_fused:
+                pods_ok_v = npods < a["node_max_pods"]
+                loc_val, loc_idx_l, node_score_loc = fused_choice(
+                    a["task_init_req"], avail, used_now, inv_alloc,
+                    node_static, eligible.astype(jnp.float32),
+                    pods_ok_v.astype(jnp.float32), sig_i8, fused_pars,
+                    score_families)
+                loc_idx = loc_idx_l + my_base
+                feas = None  # fused: no [T,N_loc] matrix materialized
+            else:
+                if feas0 is None:
+                    pods_ok = (npods < a["node_max_pods"])[None, :]
+                    feas0 = (fits_matrix(a["task_init_req"], avail, thr,
+                                         scalar_mask)
+                             & sig_feas & pods_ok)
+                feas = feas0 & eligible[:, None]
+                score = score_matrix(a["task_init_req"], avail, used_now,
+                                     a["node_alloc"], sp, score_families)
+                masked = jnp.where(feas, score, NEG)
+                loc_val = jnp.max(masked, axis=1)                 # [T]
+                loc_idx = jnp.argmax(masked, axis=1).astype(jnp.int32) \
+                    + my_base
+                node_score_loc = jnp.max(masked, axis=0)          # [N_loc]
 
             # personal best across devices
-            loc_val = jnp.max(masked, axis=1)                     # [T]
-            loc_idx = jnp.argmax(masked, axis=1).astype(jnp.int32) + my_base
             vals = jax.lax.all_gather(loc_val, "n")               # [D,T]
             idxs = jax.lax.all_gather(loc_idx, "n")               # [D,T]
             best_dev = jnp.argmax(vals, axis=0)                   # [T]
@@ -166,7 +208,6 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
             personal = jnp.where(has_any, personal, -1)
 
             if herd_mode in ("pack", "spread"):
-                node_score_loc = jnp.max(masked, axis=0)          # [N_loc]
                 n_elig = jnp.maximum(jnp.sum(eligible), 1)
                 mean_req = jnp.sum(a["task_init_req"] * eligible[:, None],
                                    axis=0) / n_elig
@@ -205,17 +246,22 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 # feasibility of each task at its (possibly remote) target
                 t_loc = target - my_base
                 mine = (t_loc >= 0) & (t_loc < n_loc)
-                t_ok_loc = jnp.take_along_axis(
-                    feas, jnp.clip(t_loc, 0, n_loc - 1)[:, None],
-                    axis=1)[:, 0] & mine
+                if feas is None:  # fused path: pointwise re-derivation
+                    t_ok_loc = feas_at(eligible, avail, npods, t_loc, mine)
+                else:
+                    t_ok_loc = jnp.take_along_axis(
+                        feas, jnp.clip(t_loc, 0, n_loc - 1)[:, None],
+                        axis=1)[:, 0] & mine
                 t_ok = jax.lax.psum(t_ok_loc.astype(jnp.int32), "n") > 0
                 choice = jnp.where(t_ok, target, personal)
             else:
                 choice = personal
-            return choice, feas
+            return choice
 
-        def admit_local(choice, feas, avail, npods, r_rank):
-            """Admission for choices landing in this device's shard."""
+        def admit_local(choice, avail, npods, r_rank):
+            """Admission for choices landing in this device's shard
+            (feasibility of the chosen node was already established by
+            choose(); the prefix re-checks capacity only)."""
             c_loc = choice - my_base
             mine = (c_loc >= 0) & (c_loc < n_loc) & (choice >= 0)
             c_loc = jnp.where(mine, c_loc, -1)
@@ -265,15 +311,28 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                         # placeability prefilter (see ops/solver.py): a
                         # task no node in ANY shard can take must not
                         # hold its sibling group's min key or budget.
-                        # feas0 is handed to choose() so the [T,N_loc]
-                        # matrix is built once per round.
+                        # Dense mode hands feas0 to choose() so the
+                        # [T,N_loc] matrix is built once per round; fused
+                        # mode pays one extra kernel pass instead.
                         pods_ok_v = npods < a["node_max_pods"]
-                        feas0 = (fits_matrix(a["task_init_req"], avail,
-                                             thr, scalar_mask)
-                                 & sig_feas & pods_ok_v[None, :])
-                        placeable = jax.lax.psum(
-                            jnp.any(feas0, axis=1).astype(jnp.int32),
-                            "n") > 0
+                        if use_fused:
+                            used_now0 = a["node_used"] \
+                                + (a["node_idle"] - idle)
+                            best_s0, _, _ = fused_choice(
+                                a["task_init_req"], avail, used_now0,
+                                inv_alloc, node_static,
+                                eligible.astype(jnp.float32),
+                                pods_ok_v.astype(jnp.float32), sig_i8,
+                                fused_pars, score_families)
+                            placeable = jax.lax.pmax(
+                                best_s0, "n") > NEG * 0.5
+                        else:
+                            feas0 = (fits_matrix(a["task_init_req"],
+                                                 avail, thr, scalar_mask)
+                                     & sig_feas & pods_ok_v[None, :])
+                            placeable = jax.lax.psum(
+                                jnp.any(feas0, axis=1).astype(jnp.int32),
+                                "n") > 0
                         r_rank, eligible = hdrf_rank_cap(
                             eligible & placeable, jobres)
                     else:
@@ -290,9 +349,9 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     eligible = eligible & _queue_cap_mask(
                         eligible, task_queue, a["task_req"], qrem, thr,
                         scalar_mask, qp, q_seg_start)
-                choice, feas = choose(eligible, avail, idle, npods, feas0)
+                choice = choose(eligible, avail, idle, npods, feas0)
                 new_assign, debit, pod_inc = admit_local(
-                    choice, feas, avail, npods, r_rank)
+                    choice, avail, npods, r_rank)
                 got = new_assign >= 0
                 assigned = jnp.where(got, new_assign, assigned)
                 kind = jnp.where(got, jnp.int32(1 if use_future else 0), kind)
