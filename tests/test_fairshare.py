@@ -483,17 +483,22 @@ class TestHDRFProgressiveParity:
 
     HIER = [("root/a", "10/8"), ("root/b", "10/2"),
             ("root/c/x", "10/5/6"), ("root/c/y", "10/5/2")]
+    #: ragged depths + heavy weight skew: the encoding's padded levels and
+    #: the cap's weight-proportional steps both get exercised hard
+    HIER_RAGGED = [("root/p", "10/9"), ("root/q/u/m", "10/1/3/5"),
+                   ("root/q/u/n", "10/1/3/1"), ("root/q/v", "10/1/1")]
     #: cpu-heavy, mem-heavy and mixed profiles: random picks compose
     #: same-dominant and disjoint-dominant sibling subtrees
     PROFILES = [("1", "1Gi"), ("1", "64Mi"), ("100m", "1Gi")]
 
-    def _run(self, seed, mode):
+    def _run(self, seed, mode, hier=None):
         import numpy as np
 
+        hier = hier or self.HIER
         rng = np.random.default_rng(seed)
         queues, pgs, pods = [], [], []
         for k in range(4):
-            h, w = self.HIER[k % 4]
+            h, w = hier[k % 4]
             qn = f"q{k}"
             queues.append(build_queue(qn, annotations={
                 "volcano.sh/hierarchy": h,
@@ -530,16 +535,26 @@ class TestHDRFProgressiveParity:
             placed[jk] = placed.get(jk, 0) + 1
         return placed
 
-    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
-    def test_solver_matches_host_progressive_filling(self, seed):
-        host = self._run(seed, "host")
-        solver = self._run(seed, "solver")
+    def _check(self, host, solver):
         if host == solver:
             return
-        assert sum(host.values()) == sum(solver.values()), (host, solver)
+        # totals may differ by ONE task: the kernel's float32 scale-aware
+        # fit tolerance (ops.solver.REL_FIT_TOL) can admit an exact fit
+        # the host's float64 math rejects by a handful of bytes
+        assert abs(sum(host.values()) - sum(solver.values())) <= 1, \
+            (host, solver)
         for k in set(host) | set(solver):
             assert abs(host.get(k, 0) - solver.get(k, 0)) <= 1, \
                 (host, solver)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+    def test_solver_matches_host_progressive_filling(self, seed):
+        self._check(self._run(seed, "host"), self._run(seed, "solver"))
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_ragged_weight_skewed_trees(self, seed):
+        self._check(self._run(seed, "host", self.HIER_RAGGED),
+                    self._run(seed, "solver", self.HIER_RAGGED))
 
 
 class TestHDRFRaggedParity:
