@@ -535,14 +535,14 @@ class TestHDRFProgressiveParity:
             placed[jk] = placed.get(jk, 0) + 1
         return placed
 
-    def _check(self, host, solver):
+    def _check(self, host, solver, total_tol=0):
         if host == solver:
             return
-        # totals may differ by ONE task: the kernel's float32 scale-aware
-        # fit tolerance (ops.solver.REL_FIT_TOL) can admit an exact fit
-        # the host's float64 math rejects by a handful of bytes
-        assert abs(sum(host.values()) - sum(solver.values())) <= 1, \
-            (host, solver)
+        # total_tol=1 only where observed: the kernel's float32
+        # scale-aware fit tolerance (ops.solver.REL_FIT_TOL) can admit an
+        # exact fit the host's float64 math rejects by a handful of bytes
+        assert abs(sum(host.values()) - sum(solver.values())) \
+            <= total_tol, (host, solver)
         for k in set(host) | set(solver):
             assert abs(host.get(k, 0) - solver.get(k, 0)) <= 1, \
                 (host, solver)
@@ -554,7 +554,8 @@ class TestHDRFProgressiveParity:
     @pytest.mark.parametrize("seed", [0, 1, 2, 3])
     def test_ragged_weight_skewed_trees(self, seed):
         self._check(self._run(seed, "host", self.HIER_RAGGED),
-                    self._run(seed, "solver", self.HIER_RAGGED))
+                    self._run(seed, "solver", self.HIER_RAGGED),
+                    total_tol=1)
 
 
 class TestHDRFRaggedParity:
