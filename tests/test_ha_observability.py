@@ -261,10 +261,18 @@ class TestCrossProcessHA:
             procs[leader].wait(timeout=10)
             kill_time = time.time()
             # the takeover may legally happen at renew_time + duration,
-            # which can precede kill_time: anchor the timing assert there
+            # which can precede kill_time: anchor the timing assert there.
+            # On a loaded host the standby can already have ACQUIRED the
+            # lease between the kill and this read — then the lease seen
+            # here is the new leader's (fresh renew_time) and no dead
+            # -lease expiry can be reconstructed; the timing assert is
+            # skipped (the elector's own expiry-gated CAS is unit-tested)
             dead_lease = store.get("leases", "volcano")
-            expiry = (dead_lease.renew_time
-                      + dead_lease.lease_duration_seconds)
+            if dead_lease.holder_identity == leader:
+                expiry = (dead_lease.renew_time
+                          + dead_lease.lease_duration_seconds)
+            else:
+                expiry = None
 
             # submit more work; the standby must take over after expiry
             for i in range(1, 4):
@@ -285,8 +293,9 @@ class TestCrossProcessHA:
             # from the dead leader raced the takeover (0.1s clock slack)
             post_kill = [b for b in binds if b[2] > kill_time
                          and b[0] != "p0"]
-            assert post_kill and min(b[2] for b in post_kill) \
-                >= expiry - 0.1
+            assert post_kill
+            if expiry is not None:
+                assert min(b[2] for b in post_kill) >= expiry - 0.1
         finally:
             for p in procs.values():
                 if p.poll() is None:
