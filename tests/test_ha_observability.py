@@ -208,6 +208,7 @@ class TestCrossProcessHA:
         # node_name) and flag double-binds / binds while leaderless
         binds = []
         violations = []
+        lease_renews = {}  # holder identity -> latest renew_time written
 
         def audit(verb, kind, obj):
             if kind == "pods" and verb == "update" and obj.node_name:
@@ -217,6 +218,8 @@ class TestCrossProcessHA:
                     violations.append(
                         (obj.name, prev.node_name, obj.node_name))
                 binds.append((obj.name, obj.node_name, time.time()))
+            if kind == "leases" and getattr(obj, "holder_identity", None):
+                lease_renews[obj.holder_identity] = obj.renew_time
             return obj
 
         store.add_interceptor(audit)
@@ -261,18 +264,14 @@ class TestCrossProcessHA:
             procs[leader].wait(timeout=10)
             kill_time = time.time()
             # the takeover may legally happen at renew_time + duration,
-            # which can precede kill_time: anchor the timing assert there.
-            # On a loaded host the standby can already have ACQUIRED the
-            # lease between the kill and this read — then the lease seen
-            # here is the new leader's (fresh renew_time) and no dead
-            # -lease expiry can be reconstructed; the timing assert is
-            # skipped (the elector's own expiry-gated CAS is unit-tested)
-            dead_lease = store.get("leases", "volcano")
-            if dead_lease.holder_identity == leader:
-                expiry = (dead_lease.renew_time
-                          + dead_lease.lease_duration_seconds)
-            else:
-                expiry = None
+            # which can precede kill_time: anchor the timing assert on
+            # the dead leader's LAST AUDITED renewal (the interceptor
+            # records every lease write, so the expiry is reconstructable
+            # even when a loaded host lets the standby acquire the lease
+            # between the kill and this point)
+            duration = store.get("leases",
+                                 "volcano").lease_duration_seconds
+            expiry = lease_renews[leader] + duration
 
             # submit more work; the standby must take over after expiry
             for i in range(1, 4):
@@ -294,8 +293,7 @@ class TestCrossProcessHA:
             post_kill = [b for b in binds if b[2] > kill_time
                          and b[0] != "p0"]
             assert post_kill
-            if expiry is not None:
-                assert min(b[2] for b in post_kill) >= expiry - 0.1
+            assert min(b[2] for b in post_kill) >= expiry - 0.1
         finally:
             for p in procs.values():
                 if p.poll() is None:

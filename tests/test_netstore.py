@@ -527,3 +527,29 @@ class TestSlowWatcher:
             sock.close()
         finally:
             server.stop()
+
+
+class TestVcctlTLSFlags:
+    def test_vcctl_applies_over_tls_with_flags(self, tmp_path):
+        """vcctl --server --token --tls-ca drives a TLS-served store
+        (the deployed-control-plane path with encryption on)."""
+        from volcano_tpu.cli.vcctl import main as vcctl
+        from volcano_tpu.webhooks.server import generate_self_signed_cert
+
+        cert, key = generate_self_signed_cert(str(tmp_path))
+        store = ClusterStore()
+        server = StoreServer(store, token="t0k",
+                             tls_cert=cert, tls_key=key).start()
+        try:
+            qy = tmp_path / "q.yaml"
+            qy.write_text(
+                "apiVersion: scheduling.volcano.sh/v1beta1\n"
+                "kind: Queue\n"
+                "metadata: {name: tls-q}\n"
+                "spec: {weight: 3}\n")
+            out = vcctl(["--server", server.address, "--token", "t0k",
+                         "--tls-ca", cert, "apply", "-f", str(qy)])
+            assert "queue/tls-q" in out
+            assert store.get("queues", "tls-q").spec.weight == 3
+        finally:
+            server.stop()

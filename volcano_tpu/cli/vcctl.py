@@ -305,6 +305,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drive a deployed control plane over TCP "
                         "(standalone --serve-store) instead of an "
                         "in-process store")
+    p.add_argument("--token", default=None,
+                   help="store auth token (default $VOLCANO_STORE_TOKEN)")
+    p.add_argument("--tls-ca", default=None, metavar="PEM",
+                   help="verify the store server's TLS cert against this "
+                        "CA bundle (default $VOLCANO_STORE_CA)")
     sub = p.add_subparsers(dest="group")
 
     jobp = sub.add_parser("job")
@@ -384,7 +389,13 @@ def main(argv: List[str], cluster: Optional[ClusterStore] = None) -> str:
             # the wire path of cmd/cli/vcctl.go:44-49 (kubeconfig -> API
             # server); here HOST:PORT -> standalone's StoreServer
             from ..client.remote import RemoteClusterStore
-            cluster = RemoteClusterStore(args.server)
+            cluster = RemoteClusterStore(args.server, token=args.token,
+                                         tls_ca=args.tls_ca)
+        elif args.token or args.tls_ca:
+            # succeeding against a throwaway in-process store while the
+            # user thinks they reached a deployed control plane is a trap
+            raise SystemExit(
+                "--token/--tls-ca require --server HOST:PORT")
         else:
             cluster = ClusterStore()
     if args.group == "version":
