@@ -772,3 +772,145 @@ tiers:
         assert placed["eng-prod-job"] >= 2 * placed["eng-dev-job"] - 1, \
             placed  # the 8:2-shaped prod/dev ratio
         assert placed["sci-job"] >= 3, placed
+
+
+class TestStandaloneOptions:
+    """The scheduler binary's option surface (reference
+    cmd/scheduler/app/options/options.go:77-104): default-queue routes
+    queue-less jobs, scheduler-name scopes the control plane, and
+    --leader-elect gates control-plane turns on the lease."""
+
+    def test_default_queue_routes_queueless_jobs(self):
+        import textwrap
+
+        from volcano_tpu.models import Node, Queue, QueueSpec
+        from volcano_tpu.standalone import Standalone
+
+        s = Standalone(metrics_port=0, async_effectors=False,
+                       default_queue="team-x")
+        s.store.apply("queues", Queue(name="team-x",
+                                      spec=QueueSpec(weight=1)))
+        s.store.create("nodes", Node(
+            name="n1", allocatable={"cpu": "4", "memory": "8Gi",
+                                    "pods": "110"},
+            capacity={"cpu": "4", "memory": "8Gi", "pods": "110"}))
+        s.apply_job_yaml(textwrap.dedent("""
+        apiVersion: batch.volcano.sh/v1alpha1
+        kind: Job
+        metadata: {name: noq, namespace: default}
+        spec:
+          minAvailable: 1
+          schedulerName: volcano
+          tasks:
+            - replicas: 2
+              name: work
+              template:
+                spec:
+                  containers:
+                    - name: main
+                      image: nginx
+                      resources:
+                        requests: {cpu: "1"}
+        """))
+        for _ in range(4):
+            s.run_once()
+        pg = s.store.get("podgroups", "noq", "default")
+        assert pg.spec.queue == "team-x"
+        pods = s.store.list("pods", namespace="default")
+        assert len(pods) == 2 and all(p.node_name for p in pods)
+        s.stop()
+
+    def test_leader_elect_gates_turns_on_the_lease(self):
+        import threading
+        import time as _time
+
+        from volcano_tpu.models import Node
+        from volcano_tpu.standalone import Standalone
+        from volcano_tpu.utils import LeaderElector, LeaseLock
+
+        s = Standalone(metrics_port=0, async_effectors=False,
+                       leader_elect=True, period=0.01)
+        s.scheduler.period = 0.01
+        s.store.create("nodes", Node(
+            name="n1", allocatable={"cpu": "4", "memory": "8Gi",
+                                    "pods": "110"},
+            capacity={"cpu": "4", "memory": "8Gi", "pods": "110"}))
+        # a foreign holder owns the lease: the standalone must idle
+        other = LeaderElector(LeaseLock(s.store, "volcano"),
+                              identity="other")
+        other.step()
+        t = threading.Thread(target=s.run, daemon=True)
+        t.start()
+        s.apply_job_yaml("""
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata: {name: gated, namespace: default}
+spec:
+  minAvailable: 1
+  schedulerName: volcano
+  tasks:
+    - replicas: 1
+      name: work
+      template:
+        spec:
+          containers:
+            - name: main
+              image: nginx
+              resources:
+                requests: {cpu: "1"}
+""")
+        _time.sleep(0.5)
+        pods = s.store.list("pods", namespace="default")
+        assert all(not p.node_name for p in pods), \
+            "standby scheduled while another process held the lease"
+        other.release()
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            pods = s.store.list("pods", namespace="default")
+            if pods and all(p.node_name for p in pods):
+                break
+            _time.sleep(0.05)
+        assert pods and all(p.node_name for p in pods)
+        s.stop()
+        t.join(timeout=5)
+
+    def test_scheduler_name_scopes_the_whole_control_plane(self):
+        import textwrap
+
+        from volcano_tpu.models import Node
+        from volcano_tpu.standalone import Standalone
+
+        s = Standalone(metrics_port=0, async_effectors=False,
+                       scheduler_name="volcano-blue")
+        s.store.create("nodes", Node(
+            name="n1", allocatable={"cpu": "4", "memory": "8Gi",
+                                    "pods": "110"},
+            capacity={"cpu": "4", "memory": "8Gi", "pods": "110"}))
+        # schedulerName omitted: the mutate webhook must default it to
+        # THIS control plane's name, and the cache must accept the pods
+        s.apply_job_yaml(textwrap.dedent("""
+        apiVersion: batch.volcano.sh/v1alpha1
+        kind: Job
+        metadata: {name: blue, namespace: default}
+        spec:
+          minAvailable: 1
+          tasks:
+            - replicas: 2
+              name: work
+              template:
+                spec:
+                  containers:
+                    - name: main
+                      image: nginx
+                      resources:
+                        requests: {cpu: "1"}
+        """))
+        for _ in range(4):
+            s.run_once()
+        job = s.store.get("jobs", "blue", "default")
+        assert job.spec.scheduler_name == "volcano-blue"
+        pods = s.store.list("pods", namespace="default")
+        assert len(pods) == 2 and all(p.node_name for p in pods), \
+            [p.node_name for p in pods]
+        assert all(p.scheduler_name == "volcano-blue" for p in pods)
+        s.stop()

@@ -110,7 +110,10 @@ def _job_from_yaml(raw: dict) -> Job:
         spec=JobSpec(
             min_available=int(spec.get("minAvailable", 0)),
             queue=spec.get("queue", ""),
-            scheduler_name=spec.get("schedulerName", "volcano"),
+            # empty when the YAML names none: the mutate webhook fills
+            # the CONTROL PLANE's scheduler name (its --scheduler-name),
+            # which the CLI cannot know
+            scheduler_name=spec.get("schedulerName", ""),
             tasks=tasks,
             plugins=spec.get("plugins", {}) or {},
             policies=_policies_from_yaml(spec.get("policies")),

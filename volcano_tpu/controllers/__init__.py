@@ -21,9 +21,10 @@ class ControllerManager:
     queue (single-core stand-in for the per-controller worker loops)."""
 
     def __init__(self, cluster, scheduler_name: str = "volcano",
-                 worker_num: int = 3):
+                 default_queue: str = "default", worker_num: int = 3):
         self.opt = ControllerOption(cluster=cluster,
                                     scheduler_name=scheduler_name,
+                                    default_queue=default_queue,
                                     worker_num=worker_num)
         self.controllers = [
             JobController(),

@@ -12,6 +12,7 @@ from ..client.store import ClusterStore
 class ControllerOption:
     cluster: ClusterStore
     scheduler_name: str = "volcano"
+    default_queue: str = "default"
     worker_num: int = 3
 
 

@@ -70,6 +70,7 @@ class JobController(Controller):
     def __init__(self):
         self.cluster: Optional[ClusterStore] = None
         self.scheduler_name = "volcano"
+        self.default_queue = "default"
         self.worker_num = 3
         self.cache = JobCache()
         self.queues: List[List[Request]] = []
@@ -90,6 +91,7 @@ class JobController(Controller):
     def initialize(self, opt: ControllerOption) -> None:
         self.cluster = opt.cluster
         self.scheduler_name = opt.scheduler_name
+        self.default_queue = opt.default_queue
         self.worker_num = max(opt.worker_num, 1)
         self.queues = [[] for _ in range(self.worker_num)]
 
@@ -337,7 +339,7 @@ class JobController(Controller):
                 name=job.name, namespace=job.namespace,
                 spec=PodGroupSpec(
                     min_member=job.spec.min_available,
-                    queue=job.spec.queue or "default",
+                    queue=job.spec.queue or self.default_queue,
                     priority_class_name=job.spec.priority_class_name,
                     min_resources=self.calc_pg_min_resources(job)),
                 owner_references=[{"kind": "Job", "name": job.name,

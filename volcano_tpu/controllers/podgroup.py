@@ -21,6 +21,7 @@ class PodGroupController(Controller):
     def __init__(self):
         self.cluster: Optional[ClusterStore] = None
         self.scheduler_name = "volcano"
+        self.default_queue = "default"
         self.queue: List[str] = []  # pod keys
 
     def name(self) -> str:
@@ -29,6 +30,7 @@ class PodGroupController(Controller):
     def initialize(self, opt: ControllerOption) -> None:
         self.cluster = opt.cluster
         self.scheduler_name = opt.scheduler_name
+        self.default_queue = opt.default_queue
 
     def run(self) -> None:
         self.cluster.watch("pods", self._on_pod)
@@ -61,7 +63,7 @@ class PodGroupController(Controller):
                 {"kind": "Pod", "name": pod.name, "uid": pod.uid}
             self.cluster.create("podgroups", PodGroup(
                 name=pg_name, namespace=pod.namespace,
-                spec=PodGroupSpec(min_member=1, queue="default",
+                spec=PodGroupSpec(min_member=1, queue=self.default_queue,
                                   priority_class_name=pod.priority_class_name),
                 owner_references=[owner]))
         pod.annotations[POD_GROUP_ANNOTATION] = pg_name

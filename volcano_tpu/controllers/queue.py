@@ -26,6 +26,7 @@ class QueueController(Controller):
         return "queue-controller"
 
     def initialize(self, opt: ControllerOption) -> None:
+        self.default_queue = opt.default_queue
         self.cluster = opt.cluster
 
     def run(self) -> None:
@@ -38,7 +39,7 @@ class QueueController(Controller):
             self.queue.append(queue.name)
 
     def _on_podgroup(self, event, pg, old) -> None:
-        queue = pg.spec.queue or "default"
+        queue = pg.spec.queue or self.default_queue
         self.queue.append(queue)
 
     def _on_command(self, event, cmd, old) -> None:
@@ -81,7 +82,7 @@ class QueueController(Controller):
         pgs = self.cluster.list("podgroups")
         has_pgs = False
         for pg in pgs:
-            if (pg.spec.queue or "default") != name:
+            if (pg.spec.queue or self.default_queue) != name:
                 continue
             has_pgs = True
             phase = pg.status.phase

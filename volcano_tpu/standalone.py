@@ -39,7 +39,11 @@ class Standalone:
                  serve_store: Optional[str] = None,
                  webhook_client_ca: Optional[str] = None,
                  webhook_bind: Optional[str] = None,
-                 store_token: Optional[str] = None):
+                 store_token: Optional[str] = None,
+                 scheduler_name: str = "volcano",
+                 default_queue: str = "default",
+                 percentage_of_nodes_to_find: int = 100,
+                 leader_elect: bool = False):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -51,7 +55,8 @@ class Standalone:
         # admission interceptors must be installed BEFORE the store starts
         # accepting remote writes, or an early vcctl create slips past the
         # webhook chain
-        start_webhooks(self.store)
+        start_webhooks(self.store, scheduler_name=scheduler_name,
+                       default_queue=default_queue)
         self.store_server = None
         if serve_store:
             # the API-server seam as an actual server: vcctl --server and
@@ -109,18 +114,26 @@ class Standalone:
                     "--webhook-client-ca (mutual TLS)")
             self.webhook_server = serve_webhooks(
                 self.store, host=wh_host, port=wh_port,
-                client_ca_path=webhook_client_ca)
+                client_ca_path=webhook_client_ca,
+                scheduler_name=scheduler_name,
+                default_queue=default_queue)
             self.webhook_server.start_background()
         self.cache = SchedulerCache(self.store,
+                                    scheduler_name=scheduler_name,
                                     async_effectors=async_effectors)
         if sidecar_path:
             from .parallel.sidecar import SidecarSolver
             self.cache.sidecar = SidecarSolver(sidecar_path)
         self.cache.run()
-        self.controllers = ControllerManager(self.store)
+        self.controllers = ControllerManager(
+            self.store, scheduler_name=scheduler_name,
+            default_queue=default_queue)
         self.controllers.run()
-        self.scheduler = Scheduler(self.cache, scheduler_conf=scheduler_conf,
-                                   period=period)
+        self.scheduler = Scheduler(
+            self.cache, scheduler_conf=scheduler_conf, period=period,
+            percentage_of_nodes_to_find=percentage_of_nodes_to_find)
+        self.leader_elect = leader_elect
+        self._elector = None
         self.metrics_server = MetricsServer(port=metrics_port).start()
         self._stop = threading.Event()
 
@@ -132,7 +145,22 @@ class Standalone:
         self.cache.wait_for_effects()
 
     def run(self) -> None:
+        if self.leader_elect:
+            # HA mode (cmd/scheduler/app/server.go:85-145): only the
+            # lease holder turns the control plane; a standby pointed at
+            # the same (remote) store takes over when the lease expires
+            from .utils import LeaderElector, LeaseLock
+
+            elector = LeaderElector(LeaseLock(self.store, "volcano"))
+            self._elector = elector
+            renewer = threading.Thread(target=elector.run,
+                                       args=(self._stop,),
+                                       name="leader-elector", daemon=True)
+            renewer.start()
         while not self._stop.is_set():
+            if self._elector is not None and not self._elector.is_leader:
+                self._stop.wait(0.05)
+                continue
             t0 = time.time()
             try:
                 self.run_once()
@@ -180,6 +208,18 @@ def main(argv=None) -> int:
                          "--server and remote components can drive this "
                          "control plane; non-loopback binds require "
                          "VOLCANO_STORE_TOKEN (shared-secret auth)")
+    ap.add_argument("--scheduler-name", default="volcano",
+                    help="only schedule pods/jobs naming this scheduler "
+                         "(options.go: --scheduler-name)")
+    ap.add_argument("--default-queue", default="default",
+                    help="queue assigned to jobs/podgroups that name "
+                         "none (options.go: --default-queue)")
+    ap.add_argument("--percentage-nodes-to-find", type=int, default=100,
+                    help="adaptive node sampling target percentage "
+                         "(options.go: --percentage-nodes-to-find)")
+    ap.add_argument("--leader-elect", action="store_true",
+                    help="contend on the 'volcano' lease; only the "
+                         "holder runs control-plane turns")
     args = ap.parse_args(argv)
 
     conf = None
@@ -192,7 +232,11 @@ def main(argv=None) -> int:
                     metrics_port=args.metrics_port,
                     serve_store=args.serve_store,
                     webhook_client_ca=args.webhook_client_ca,
-                    webhook_bind=args.webhook_bind)
+                    webhook_bind=args.webhook_bind,
+                    scheduler_name=args.scheduler_name,
+                    default_queue=args.default_queue,
+                    percentage_of_nodes_to_find=args.percentage_nodes_to_find,
+                    leader_elect=args.leader_elect)
     if args.jobs_dir:
         import glob
         import os

@@ -14,7 +14,9 @@ import re
 
 from ..client.store import AdmissionError
 from ..models import Event, Job, QueueState
-from .router import AdmissionService, register_admission_service
+from .router import (
+    AdmissionOptions, AdmissionService, register_admission_service,
+)
 
 _DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 
@@ -59,7 +61,8 @@ def _validate_io(volumes) -> None:
                 "either VolumeClaim or VolumeClaimName must be specified")
 
 
-def validate_job(verb: str, job: Job, cluster) -> Job:
+def validate_job(verb: str, job: Job, cluster,
+                 opts: AdmissionOptions = None) -> Job:
     if verb == "delete":
         return job
     if verb == "update":
@@ -106,9 +109,11 @@ def validate_job(verb: str, job: Job, cluster) -> Job:
 
     _validate_io(job.spec.volumes)
 
-    queue = cluster.try_get("queues", job.spec.queue or "default")
+    default_queue = opts.default_queue if opts else "default"
+    queue = cluster.try_get("queues", job.spec.queue or default_queue)
     if queue is None:
-        raise AdmissionError(f"unable to find job queue: {job.spec.queue}")
+        raise AdmissionError("unable to find job queue: "
+                             f"{job.spec.queue or default_queue}")
     if queue.status.state != QueueState.OPEN:
         raise AdmissionError(
             f"can only submit job to queue with state `Open`, queue "
@@ -142,13 +147,15 @@ def _validate_update(old: Job, new: Job) -> None:
             "`minAvailable`, `tasks[*].replicas` under spec")
 
 
-def mutate_job(verb: str, job: Job, cluster) -> Job:
+def mutate_job(verb: str, job: Job, cluster,
+               opts: AdmissionOptions = None) -> Job:
     if verb != "create":
         return job
     if not job.spec.queue:
-        job.spec.queue = "default"
+        job.spec.queue = opts.default_queue if opts else "default"
     if not job.spec.scheduler_name:
-        job.spec.scheduler_name = "volcano"
+        job.spec.scheduler_name = opts.scheduler_name if opts \
+            else "volcano"
     for i, task in enumerate(job.spec.tasks):
         if not task.name:
             task.name = f"task-{i}"

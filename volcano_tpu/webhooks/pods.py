@@ -13,7 +13,8 @@ from ..models import Pod, PodGroupPhase
 from .router import AdmissionService, register_admission_service
 
 
-def validate_pod(verb: str, pod: Pod, cluster) -> Pod:
+def validate_pod(verb: str, pod: Pod, cluster,
+                 opts=None) -> Pod:
     if verb != "create":
         return pod
     if pod.scheduler_name != "volcano":

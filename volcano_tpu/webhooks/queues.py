@@ -14,7 +14,8 @@ from ..models import Queue
 from .router import AdmissionService, register_admission_service
 
 
-def validate_queue(verb: str, queue: Queue, cluster) -> Queue:
+def validate_queue(verb: str, queue: Queue, cluster,
+                   opts=None) -> Queue:
     if verb == "delete":
         if queue.name == "default":
             raise AdmissionError("`default` queue can not be deleted")
@@ -62,7 +63,8 @@ def validate_queue(verb: str, queue: Queue, cluster) -> Queue:
     return queue
 
 
-def mutate_queue(verb: str, queue: Queue, cluster) -> Queue:
+def mutate_queue(verb: str, queue: Queue, cluster,
+                 opts=None) -> Queue:
     if verb != "create":
         return queue
     if not queue.spec.weight:

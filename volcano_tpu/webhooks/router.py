@@ -15,11 +15,20 @@ from ..client.store import AdmissionError, ClusterStore
 
 
 @dataclass
+class AdmissionOptions:
+    """Per-control-plane admission config (the webhook-manager binary's
+    flags): instance state, NOT module globals, so multiple control
+    planes in one process can't clobber each other."""
+    scheduler_name: str = "volcano"
+    default_queue: str = "default"
+
+
+@dataclass
 class AdmissionService:
     path: str
     kind: str                     # store bucket name, e.g. "jobs"
     verbs: List[str]              # subset of {create, update, delete}
-    func: Callable                # (verb, obj, store) -> obj (raise AdmissionError to deny)
+    func: Callable                # (verb, obj, store, opts) -> obj (raise AdmissionError to deny)
 
 
 _services: List[AdmissionService] = []
@@ -37,17 +46,21 @@ class WebhookManager:
     """cmd/webhook-manager equivalent: binds every registered admission
     service to a cluster store."""
 
-    def __init__(self, cluster: ClusterStore, scheduler_name: str = "volcano"):
+    def __init__(self, cluster: ClusterStore, scheduler_name: str = "volcano",
+                 default_queue: str = "default"):
         self.cluster = cluster
         self.scheduler_name = scheduler_name
+        self.opts = AdmissionOptions(scheduler_name=scheduler_name,
+                                     default_queue=default_queue)
 
     def run(self) -> None:
         cluster = self.cluster
+        opts = self.opts
 
         def interceptor(verb: str, kind: str, obj):
             for svc in _services:
                 if svc.kind == kind and verb in svc.verbs:
-                    obj = svc.func(verb, obj, cluster)
+                    obj = svc.func(verb, obj, cluster, opts)
             return obj
 
         cluster.add_interceptor(interceptor)

@@ -162,11 +162,15 @@ class AdmissionServer:
                  cert_path: Optional[str] = None,
                  key_path: Optional[str] = None,
                  cert_dir: Optional[str] = None,
-                 client_ca_path: Optional[str] = None):
+                 client_ca_path: Optional[str] = None,
+                 opts=None):
+        from .router import AdmissionOptions
+
         if cert_path is None or key_path is None:
             cert_path, key_path = generate_self_signed_cert(cert_dir)
         self.cert_path = cert_path
         self.cluster = cluster
+        opts = opts or AdmissionOptions()
         services = {svc.path: svc for svc in list_services()}
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -186,7 +190,7 @@ class AdmissionServer:
                     model = _model_for(svc.kind)
                     obj = from_wire(model, req.get("object"))
                     if verb in svc.verbs:
-                        out = svc.func(verb, obj, cluster)
+                        out = svc.func(verb, obj, cluster, opts)
                     else:
                         # verbs the service didn't register for pass
                         # through unchanged, like the interceptor chain
