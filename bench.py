@@ -21,6 +21,13 @@ Also measured, reported in extra.configs:
       multi-resource binpack with in-kernel queue caps.
 
 Prints ONE JSON line.
+
+Fault isolation contract: every config (headline included) runs inside
+``_run_config`` — a transient ``JaxRuntimeError``/connection drop retries
+once, and anything that still fails records a per-config
+``{"error": ...}`` field instead of discarding the numbers already in
+hand. ``main`` always emits the JSON line and exits 0; a dropped tunnel
+mid-run can cost at most the one config it hit (VERDICT r5 weak #1).
 """
 
 from __future__ import annotations
@@ -115,21 +122,46 @@ def fill_queue_demand(arr, jobs, demand_cache):
     request = total demand per queue, allocated = 0. Per-job demand vectors
     cache on (uid, flat_version) like the flatten's blocks; the cache dict
     is per-config (configs reuse job uids, so sharing one would alias
-    different problems' vectors)."""
+    different problems' vectors).
+
+    The per-queue totals are maintained incrementally (float64, deltas for
+    departed/arrived/changed members only) so a 1%-churn session costs
+    O(churn) numpy ops, not one vector add per job; a periodic full
+    recompute bounds float drift far below float32 resolution."""
     qidx = {q: i for i, q in enumerate(arr.queues_list)}
-    arr.queue_request[:] = 0.0
     arr.queue_allocated[:] = 0.0
-    for job in jobs.values():
-        i = qidx.get(job.queue)
-        if i is None:
+    st = demand_cache.get("__totals__")
+    key = (tuple(arr.queues_list), arr.R)
+    Q = arr.queue_request.shape[0]
+    if st is None or st["key"] != key or st["tick"] >= 64:
+        st = {"key": key, "members": {}, "tick": 0,
+              "totals": np.zeros((Q, len(arr.vocab)), np.float64)}
+        demand_cache["__totals__"] = st
+    st["tick"] += 1
+    totals = st["totals"]
+    members = st["members"]
+    seen = {}
+    for uid, job in jobs.items():
+        v = job.flat_version
+        prev = members.get(uid)
+        qi = qidx.get(job.queue)
+        if prev is not None and prev[0] == v and prev[1] == qi:
+            seen[uid] = prev
             continue
-        ent = demand_cache.get(job.uid)
-        if ent is None or ent[0] != job.flat_version \
-                or ent[1].shape[0] != arr.R:
-            ent = (job.flat_version,
-                   job.total_request.to_vector(arr.vocab))
-            demand_cache[job.uid] = ent
-        arr.queue_request[i] += ent[1]
+        ent = demand_cache.get(uid)
+        if ent is None or ent[0] != v or ent[1].shape[0] != arr.R:
+            ent = (v, job.total_request.to_vector(arr.vocab))
+            demand_cache[uid] = ent
+        if prev is not None and prev[1] is not None:
+            totals[prev[1]] -= prev[2]
+        if qi is not None:
+            totals[qi] += ent[1]
+        seen[uid] = (v, qi, ent[1])
+    for uid, prev in members.items():
+        if uid not in seen and prev[1] is not None:
+            totals[prev[1]] -= prev[2]
+    st["members"] = seen
+    arr.queue_request[:] = totals.astype(np.float32)
 
 
 def headline():
@@ -224,14 +256,18 @@ def headline():
         chunks.append(dcache.last_shipped_chunks)
         rtts.append(rtt_probe(1))
         placed = int((assigned[:len(tasks_s)] >= 0).sum())
-    # flatten-only share (warm, with churn)
-    jobs_s, tasks_s, grouped_s = churn(4 + SESSIONS)
-    t0 = time.perf_counter()
-    arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
-                           queues=queues, grouped=grouped_s)
-    fill_queue_demand(arr, jobs_s, demand_cache)
-    arr.packed()
-    flatten_ms = (time.perf_counter() - t0) * 1e3
+    # flatten-only share (warm, with churn): 5 reps so the artifact
+    # carries the spread, not a single draw
+    fl_reps = []
+    for rep in range(5):
+        jobs_s, tasks_s, grouped_s = churn(4 + SESSIONS + rep)
+        t0 = time.perf_counter()
+        arr = flatten_snapshot(jobs_s, nodes, tasks_s, cache=fcache,
+                               queues=queues, grouped=grouped_s)
+        fill_queue_demand(arr, jobs_s, demand_cache)
+        arr.packed()
+        fl_reps.append((time.perf_counter() - t0) * 1e3)
+    flatten_ms = float(np.median(fl_reps))
 
     # device-bound solve rate: back-to-back solves on device-resident
     # buffers — the throughput a locally-attached chip sustains, without
@@ -310,6 +346,7 @@ def headline():
         # + device solve, no tunnel in the loop
         "p50_local_estimate_ms": round(flatten_ms + device_ms, 2),
         "flatten_ms": round(flatten_ms, 2),
+        "flatten_ms_reps": [round(x, 2) for x in fl_reps],
         "shipped_chunks_mean": round(float(np.mean(chunks)), 1),
         "placed": placed,
         "sessions": SESSIONS,
@@ -428,11 +465,16 @@ def full_cycle():
     f2d, i2d = dc._dev_f, dc._dev_i
     solve_allocate_packed2d(
         f2d, i2d, lay, sd_params, **fl).compact.block_until_ready()
-    t0 = time.perf_counter()
-    futs = [solve_allocate_packed2d(f2d, i2d, lay, sd_params, **fl)
-            for _ in range(SESSIONS)]
-    futs[-1].compact.block_until_ready()
-    steady_device_ms = (time.perf_counter() - t0) / SESSIONS * 1e3
+    # 3 reps (median + recorded spread): whether a device-time drift is
+    # rig noise or a regression must be readable from one artifact
+    sd_reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        futs = [solve_allocate_packed2d(f2d, i2d, lay, sd_params, **fl)
+                for _ in range(SESSIONS)]
+        futs[-1].compact.block_until_ready()
+        sd_reps.append((time.perf_counter() - t0) / SESSIONS * 1e3)
+    steady_device_ms = float(np.median(sd_reps))
 
     p50 = float(np.percentile(lat, 50))
     host_p50 = float(np.percentile(host_ms, 50))
@@ -453,6 +495,7 @@ def full_cycle():
         **spread_fields("steady_host", host_ms),
         "steady_solve_p50_ms": round(solve_p50, 2),
         "steady_device_ms": round(steady_device_ms, 2),
+        "steady_device_ms_reps": [round(x, 2) for x in sd_reps],
         "steady_rtt_p50_ms": round(float(np.median(rtts)), 2),
         "steady_rtt_drift_ratio": round(rtt_drift, 2),
         "steady_rtt_unstable": bool(rtt_drift > 2.0),
@@ -477,40 +520,52 @@ def sharded_path_compare(single_device_ms):
     """Single-device vs shard_map solver on the SAME problem and chip
     (VERDICT r4 missing #2's measurement): a 1-device mesh on the real
     TPU runs the sharded code path — per-shard fused pallas kernel,
-    collectives degraded to identity — so its device-bound rate is
-    directly comparable to the single-device solver's. Multi-chip
-    behavior itself is proven on the virtual mesh (tests/test_parallel)
-    and by the driver's dryrun; this records what the sharded path costs
-    on silicon."""
+    collectives now SKIPPED AT TRACE TIME at D=1 (the compiled program is
+    collective-free, tests/test_parallel.py::TestShardedD1ZeroCost) — so
+    its device-bound rate is directly comparable to the single-device
+    solver's. Both sides dispatch the same device-resident packed-buffer
+    form (solve_allocate_*_packed2d), so the measured ratio is pure
+    shard_map wrapper cost, not a transfer asymmetry. Multi-chip behavior
+    itself is proven on the virtual mesh (tests/test_parallel) and by the
+    driver's dryrun; this records what the sharded path costs on
+    silicon."""
     import jax
     from __graft_entry__ import _params
-    from volcano_tpu.ops import flatten_snapshot
+    from volcano_tpu.ops import PackedDeviceCache, flatten_snapshot
     from volcano_tpu.ops.pallas_kernels import fused_choice_auto
-    from volcano_tpu.parallel import make_mesh, solve_allocate_sharded
+    from volcano_tpu.parallel import (
+        make_mesh, solve_allocate_sharded_packed2d,
+    )
 
     jobs, nodes, tasks, queues = make_problem(
         2000, 1000, 10, n_queues=3, queue_weights=[1, 2, 3])
     arr = flatten_snapshot(jobs, nodes, tasks, queues=queues)
     fill_queue_demand(arr, jobs, {})
-    d = {k: jax.device_put(v) for k, v in arr.device_dict().items()}
+    fbuf, ibuf, layout = arr.packed()
+    f2d, i2d = PackedDeviceCache().update(fbuf, ibuf, layout)
     params = {k: jax.device_put(np.asarray(v))
               for k, v in _params(arr).items()}
     mesh = make_mesh(jax.devices()[:1])
-    res = solve_allocate_sharded(d, params, mesh, use_queue_cap=True)
+    res = solve_allocate_sharded_packed2d(f2d, i2d, layout, params, mesh,
+                                          use_queue_cap=True)
     res.assigned.block_until_ready()  # compile
     reps = []
     for _ in range(3):  # median of 3 like the single-device measurement
         t0 = time.perf_counter()
-        futs = [solve_allocate_sharded(d, params, mesh, use_queue_cap=True)
+        futs = [solve_allocate_sharded_packed2d(
+                    f2d, i2d, layout, params, mesh, use_queue_cap=True)
                 for _ in range(SESSIONS)]
         futs[-1].assigned.block_until_ready()
         reps.append((time.perf_counter() - t0) / SESSIONS * 1e3)
     sharded_ms = float(np.median(reps))
     placed = int((np.asarray(res.assigned)[:len(tasks)] >= 0).sum())
+    ratio = (sharded_ms / single_device_ms
+             if single_device_ms and single_device_ms > 0 else None)
     return {
         "sharded_device_ms": round(sharded_ms, 2),
         "sharded_device_ms_reps": [round(x, 2) for x in reps],
         "single_device_ms": round(single_device_ms, 2),
+        "sharded_over_single": round(ratio, 3) if ratio else None,
         "fused_on_shard": bool(
             jax.default_backend() == "tpu"
             and fused_choice_auto(arr.T, arr.N)),
@@ -759,32 +814,75 @@ def config5_hierarchical():
     }
 
 
+_TRANSIENT_MARKERS = (
+    "remote_compile", "read body", "connection", "Connection", "socket",
+    "UNAVAILABLE", "DEADLINE", "timed out", "timeout", "closed",
+)
+
+
+def _run_config(name, fn, retries: int = 1):
+    """Per-config fault isolation (see module docstring): retry once on a
+    transient JaxRuntimeError/connection drop, and convert anything that
+    still fails into a {"error": ...} record so the configs already
+    measured are never discarded."""
+    import traceback
+
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — the artifact IS the report
+            msg = f"{type(e).__name__}: {e}"
+            transient = ("JaxRuntimeError" in type(e).__name__
+                         or any(m in msg for m in _TRANSIENT_MARKERS))
+            if attempt < retries and transient:
+                print(f"# {name}: transient failure, retrying: "
+                      f"{msg.splitlines()[0][:200]}", file=sys.stderr)
+                time.sleep(2.0)
+                continue
+            return {
+                "error": msg.strip()[:500],
+                "traceback_tail":
+                    traceback.format_exc().strip().splitlines()[-3:],
+                "attempts": attempt + 1,
+            }
+
+
 def main() -> int:
     t_setup = time.time()
     import jax
 
-    h = headline()
-    configs = {
-        "config2_parity_500x50": config2_parity(),
-        "config4_preempt_2k_1k": config4_preempt(),
-        "config5_hier_5k_1k": config5_hierarchical(),
-        "sharded_path_10k_2k": sharded_path_compare(
-            h["device_ms_per_session"]),
-        "full_cycle_10k_2k": full_cycle(),
-    }
+    h = _run_config("headline", headline)
+    headline_ok = "error" not in h
+    single_dev_ms = h.get("device_ms_per_session", -1.0)
+    configs = {}
+    for name, fn in (
+        ("config2_parity_500x50", config2_parity),
+        ("config4_preempt_2k_1k", config4_preempt),
+        ("config5_hier_5k_1k", config5_hierarchical),
+        ("sharded_path_10k_2k",
+         lambda: sharded_path_compare(single_dev_ms)),
+        ("full_cycle_10k_2k", full_cycle),
+    ):
+        configs[name] = _run_config(name, fn)
     setup_s = time.time() - t_setup
 
-    p50 = h.pop("p50_ms")
+    try:
+        device = str(jax.devices()[0])
+    except Exception as e:  # noqa: BLE001
+        device = f"unavailable: {e}"
+    p50 = h.pop("p50_ms", None) if headline_ok else None
     result = {
         "metric": "p50 session latency @10k pods/2k nodes",
         "value": p50,
         "unit": "ms",
-        "vs_baseline": round(TARGET_MS / p50, 2),
+        "vs_baseline": round(TARGET_MS / p50, 2) if p50 else None,
         "extra": {
             **h,
             "configs": configs,
             "setup_s": round(setup_s, 1),
-            "device": str(jax.devices()[0]),
+            "device": device,
         },
     }
     print(json.dumps(result))

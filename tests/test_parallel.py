@@ -143,6 +143,114 @@ class TestShardedSolver:
             assert placed == (4, 4), placed
 
 
+class TestShardedD1ZeroCost:
+    """A 1-device mesh must compile to a collective-free program (the
+    shard_map constant factor every multi-chip deployment inherits): the
+    collectives are skipped at trace time when D == 1, and the results
+    stay identical to the multi-device mesh."""
+
+    _COLLECTIVES = ("all_gather", "psum", "pmax", "pmin", "all_to_all",
+                    "ppermute")
+
+    def test_no_collectives_and_same_result(self, mesh):
+        from types import SimpleNamespace
+
+        jobs, nodes, tasks = make_problem(
+            [(f"n{i}", "8", "32Gi") for i in range(16)],
+            [(f"j{k}", 4, [("1", "2Gi")] * 4) for k in range(8)])
+        queues = {"default": SimpleNamespace(weight=1, capability=None)}
+        arr = flatten_snapshot(jobs, nodes, tasks, queues=queues)
+        arr.fill_queue_demand()
+        p = params_dict(arr, binpack_weight=1.0)
+        d = arr.device_dict()
+        mesh1 = make_mesh(jax.devices()[:1])
+        kw = dict(herd_mode="pack", score_families=("binpack",),
+                  use_queue_cap=True)
+        txt = str(jax.make_jaxpr(
+            lambda dd, pp: solve_allocate_sharded(dd, pp, mesh1, **kw)
+        )(d, p))
+        for prim in self._COLLECTIVES:
+            assert prim not in txt, f"D=1 jaxpr contains {prim}"
+        r1 = solve_allocate_sharded(d, p, mesh1, **kw)
+        r8 = solve_allocate_sharded(d, p, mesh, **kw)
+        np.testing.assert_array_equal(np.asarray(r1.assigned),
+                                      np.asarray(r8.assigned))
+        np.testing.assert_array_equal(np.asarray(r1.job_ready),
+                                      np.asarray(r8.job_ready))
+
+    def test_packed2d_entry_matches(self):
+        """Device-resident packed buffers feed the sharded solver without
+        a host re-upload; the unpack fuses into the solve."""
+        from volcano_tpu.ops import PackedDeviceCache
+        from volcano_tpu.parallel import solve_allocate_sharded_packed2d
+
+        jobs, nodes, tasks = make_problem(
+            [(f"n{i}", "8", "32Gi") for i in range(8)],
+            [(f"j{k}", 2, [("1", "2Gi")] * 2) for k in range(6)])
+        arr = flatten_snapshot(jobs, nodes, tasks)
+        p = params_dict(arr, binpack_weight=1.0)
+        mesh1 = make_mesh(jax.devices()[:1])
+        kw = dict(herd_mode="pack", score_families=("binpack",))
+        ref = solve_allocate_sharded(arr.device_dict(), p, mesh1, **kw)
+        fbuf, ibuf, layout = arr.packed()
+        dc = PackedDeviceCache()
+        f2d, i2d = dc.update(fbuf, ibuf, layout)
+        res = solve_allocate_sharded_packed2d(f2d, i2d, layout, p, mesh1,
+                                              **kw)
+        np.testing.assert_array_equal(np.asarray(res.assigned),
+                                      np.asarray(ref.assigned))
+        np.testing.assert_array_equal(np.asarray(res.job_ready),
+                                      np.asarray(ref.job_ready))
+
+    def test_evict_d1_no_collectives(self):
+        from volcano_tpu.api import TaskStatus
+        from volcano_tpu.api.types import POD_GROUP_ANNOTATION
+        from volcano_tpu.models import Pod, PodGroup, PodGroupSpec
+        from volcano_tpu.ops.evict import pack_victim_arrays
+        from volcano_tpu.parallel.sharded_evict import (
+            _solve_sharded, shard_victims,
+        )
+
+        jobs, nodes, tasks = make_problem(
+            [(f"n{i}", "8", "32Gi") for i in range(4)], [])
+        low = JobInfo("ns/low", PodGroup(name="low", namespace="ns",
+                                         spec=PodGroupSpec(min_member=1)))
+        victims = []
+        for i in range(8):
+            pod = Pod(name=f"low-{i}", namespace="ns",
+                      node_name=f"n{i % 4}", phase="Running",
+                      annotations={POD_GROUP_ANNOTATION: "low"},
+                      containers=[{"requests": {"cpu": "1",
+                                                "memory": "2Gi"}}])
+            t = TaskInfo(pod)
+            t.status = TaskStatus.RUNNING
+            low.add_task_info(t)
+            nodes[f"n{i % 4}"].add_task(t)
+            victims.append(t)
+        hi = JobInfo("ns/hi", PodGroup(name="hi", namespace="ns",
+                                       spec=PodGroupSpec(min_member=4)))
+        claimers = []
+        for i in range(4):
+            pod = Pod(name=f"hi-{i}", namespace="ns",
+                      annotations={POD_GROUP_ANNOTATION: "hi"},
+                      containers=[{"requests": {"cpu": "2",
+                                                "memory": "4Gi"}}])
+            t = TaskInfo(pod)
+            hi.add_task_info(t)
+            claimers.append(t)
+        arr = flatten_snapshot({hi.uid: hi}, nodes, claimers)
+        params = params_dict(arr, least_req_weight=1.0)
+        varrays = pack_victim_arrays(arr, victims, 4)
+        sharded_v, _perm = shard_victims(varrays, arr.N, 1)
+        mesh1 = make_mesh(jax.devices()[:1])
+        txt = str(jax.make_jaxpr(
+            lambda aa, vv, pp: _solve_sharded(aa, vv, pp, mesh1,
+                                              ("kube",), False, True)
+        )(arr.device_dict(), sharded_v, params))
+        for prim in self._COLLECTIVES:
+            assert prim not in txt, f"D=1 evict jaxpr contains {prim}"
+
+
 class TestShardedEvict:
     """solve_evict_uniform_sharded vs the single-device kernel on the
     config-4 shape (scaled down): same placements count, same (minimal)

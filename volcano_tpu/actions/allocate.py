@@ -374,8 +374,6 @@ class AllocateAction(Action):
         # cost; the per-task loop blew the 1 s period on a 10k burst)
         assigned = assigned.tolist()  # plain ints: no np scalar per lookup
         kind = kind.tolist()
-        nodes_list = arr.nodes_list
-        idx = 0
         # bulk-commit window: committed statements queue their cache-side
         # binds + allocate events; ONE flush applies them with full-width
         # node grouping (per-job commits degrade to 1-task node groups
@@ -384,7 +382,7 @@ class AllocateAction(Action):
             flush_bulk_commit
         acc = begin_bulk_commit(ssn)
         try:
-            self._replay(ssn, arr, job_order, assigned, kind, acc)
+            self._replay(ssn, arr, job_order, assigned, kind)
         finally:
             # exception-safe: jobs already committed into the window MUST
             # still get their cache binds + events even if a later job's
@@ -392,7 +390,7 @@ class AllocateAction(Action):
             flush_bulk_commit(ssn, acc)
         timing["replay_ms"] = (_time.perf_counter() - t0) * 1e3
 
-    def _replay(self, ssn, arr, job_order, assigned, kind, acc) -> None:
+    def _replay(self, ssn, arr, job_order, assigned, kind) -> None:
         nodes_list = arr.nodes_list
         idx = 0
         for job, tasks in job_order:

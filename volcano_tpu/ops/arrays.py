@@ -22,8 +22,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import (
-    JobInfo, NodeInfo, Resource, ResourceVocab, TaskInfo, TaskStatus,
-    MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR,
+    JobInfo, NodeInfo, NodePhase, Resource, ResourceVocab, TaskInfo,
+    TaskStatus, MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR,
 )
 
 #: compile-bucket sizes: quarter-steps between powers of two, floor 8 —
@@ -325,6 +325,18 @@ class FlattenCache:
     (or ``cache=None``) reproduces the full flatten; results are identical
     either way because every entry is verified against the live objects'
     versions and task-uid sequences before reuse.
+
+    The assembly itself is delta-driven: the padded task/job/node arrays
+    are persistent buffers owned by the cache, and each flatten rewrites
+    only the dirty rows — the job blocks outside the common prefix/suffix
+    of the (key, version, len) job layout, and the node rows whose
+    (name, epoch, flat_version) triple moved. An unchanged-snapshot cycle
+    re-packs nothing; a 1%-churn cycle re-packs ~1% of the rows. The
+    signature and queue index tables reuse the previous session's
+    first-seen order whenever the dirty blocks' signature/queue sequences
+    are unchanged, so the packed buffers stay byte-identical to a cold
+    flatten (asserted across churn patterns by
+    tests/test_solver.py::TestFlattenIncrementalIdentity).
     """
 
     def __init__(self, vocab: Optional[ResourceVocab] = None):
@@ -334,8 +346,13 @@ class FlattenCache:
         self.sig_rows: Dict[str, tuple] = {}   # sig -> (node_key, row[N])
         self._node_key: Optional[tuple] = None
         self._node_buf: Optional[dict] = None
-        self._task_key: Optional[tuple] = None
-        self._task_buf: Optional[tuple] = None
+        #: previous task/job assembly: persistent padded buffers plus the
+        #: per-position layout ((key, version, len) per job, uid sequence,
+        #: per-block signature/queue sequences) the delta diff runs against
+        self._asm: Optional[dict] = None
+        #: cached spec-keyed signature tuple (rebuilt only when some node's
+        #: spec actually changed — accounting churn must not pay for it)
+        self._spec_key: Optional[tuple] = None
 
     # -- per-node rows ------------------------------------------------------
 
@@ -354,6 +371,7 @@ class FlattenCache:
         npods = sum(1 for t in ni.tasks.values()
                     if t.status != TaskStatus.PIPELINED)
         ent = {"v": ni.flat_version, "e": ni.flat_epoch, "R": R,
+               "sv": ni.spec_version,
                "idle": idle, "used": used,
                "extra": extra, "alloc": alloc, "npods": npods,
                "maxp": ni.allocatable.max_task_num or 1 << 30}
@@ -421,14 +439,17 @@ class FlattenCache:
 
     # -- bounded size -------------------------------------------------------
 
-    def sweep(self, live_jobs, live_nodes, live_sigs) -> None:
+    def sweep(self, jobs_list, nodes_list, live_sigs) -> None:
         """Drop entries for departed jobs/nodes/signatures once the maps grow
         well past the live set, so a churny cluster can't grow the cache
-        unboundedly (job blocks pin task arrays and Pod refs)."""
-        if len(self.job_blocks) > 2 * len(live_jobs) + 64:
+        unboundedly (job blocks pin task arrays and Pod refs). The live sets
+        are built lazily — in steady state only the size checks run."""
+        if len(self.job_blocks) > 2 * len(jobs_list) + 64:
+            live_jobs = {j.uid for j in jobs_list}
             self.job_blocks = {k: v for k, v in self.job_blocks.items()
                                if k in live_jobs}
-        if len(self.node_rows) > 2 * len(live_nodes) + 64:
+        if len(self.node_rows) > 2 * len(nodes_list) + 64:
+            live_nodes = {ni.name for ni in nodes_list}
             self.node_rows = {k: v for k, v in self.node_rows.items()
                               if k in live_nodes}
         if len(self.sig_rows) > 2 * len(live_sigs) + 64:
@@ -486,16 +507,21 @@ def flatten_snapshot(
         cache.vocab = ResourceVocab.collect(resources)
     vocab = cache.vocab
 
-    nodes_list = [n for n in nodes.values() if n.ready]
+    # inline the ready check (state.phase is a slot read; the property call
+    # costs ~0.2us x N on the per-cycle floor)
+    _ready = NodePhase.READY
+    nodes_list = [n for n in nodes.values() if n.state.phase is _ready]
     n_tasks = len(tasks_in_order)
     n_nodes = len(nodes_list)
 
     # group tasks by job, preserving order (callers that already hold the
     # per-job grouping — the allocate action — pass it via `grouped` and
     # skip this O(T) pass)
+    jobs_seq = None
     if grouped is not None:
         job_keys = [j.uid for j, _ in grouped]
         job_tasks = [ts for _, ts in grouped]
+        jobs_seq = [j for j, _ in grouped]
     else:
         job_keys: List[str] = []
         job_tasks: List[List[TaskInfo]] = []
@@ -519,15 +545,94 @@ def flatten_snapshot(
             tasks_in_order = [t for ts in job_tasks for t in ts]
             n_tasks = len(tasks_in_order)
 
+    if jobs_seq is None:
+        jobs_seq = [jobs[k] for k in job_keys]
+    versions = [j.flat_version for j in jobs_seq]
+    lens = [len(ts) for ts in job_tasks]
+    nJ = len(job_keys)
+
+    # -- delta diff against the previous assembly ---------------------------
+    # P jobs of common prefix and S of common suffix (key, version and task
+    # count all matching) frame the dirty middle; with ~1% churn the middle
+    # is a handful of job blocks, and only those are re-packed below
+    asm = cache._asm
+    if asm is not None:
+        ok_, ov_, ol_ = asm["job_keys"], asm["versions"], asm["lens"]
+        oJ = len(ok_)
+        if job_keys == ok_ and versions == ov_ and lens == ol_:
+            P, S = nJ, 0  # unchanged layout: one C-speed compare, no walk
+        else:
+            m = min(nJ, oJ)
+            P = 0
+            while P < m and job_keys[P] == ok_[P] \
+                    and versions[P] == ov_[P] and lens[P] == ol_[P]:
+                P += 1
+            S = 0
+            lim = m - P
+            while S < lim and job_keys[nJ - 1 - S] == ok_[oJ - 1 - S] \
+                    and versions[nJ - 1 - S] == ov_[oJ - 1 - S] \
+                    and lens[nJ - 1 - S] == ol_[oJ - 1 - S]:
+                S += 1
+        # verify the reusable regions' task identity: the caller passing
+        # the same task-list OBJECT (the steady grouped path) certifies
+        # the sequence unchanged for free; fresh lists fall back to a
+        # per-job uid compare (C speed; version alone is trusted nowhere,
+        # matching job_block). Callers must not reorder or mutate a task
+        # list in place once handed to a flatten — build a new list.
+        tl = asm["task_lists"]
+        tu = asm["task_uids"]
+        for j in range(P):
+            ts = job_tasks[j]
+            if ts is tl[j]:
+                continue
+            if [t.uid for t in ts] != tu[j]:
+                P = j
+                break
+        if nJ != oJ or n_tasks != asm["n_tasks"]:
+            # job positions / task offsets shift: the suffix cannot be
+            # reused in place, rewrite everything from the prefix on
+            S = 0
+        for k2 in range(S):
+            j = nJ - 1 - k2
+            ts = job_tasks[j]
+            if ts is tl[oJ - 1 - k2]:
+                continue
+            if [t.uid for t in ts] != tu[oJ - 1 - k2]:
+                S = k2
+                break
+        off_P = sum(lens[:P])
+    else:
+        oJ = 0
+        P = S = 0
+        off_P = 0
+
     # vocab growth pre-pass: only entries about to recompute can introduce
-    # new names; scanning just those here is what keeps R stable below
-    for j, key in enumerate(job_keys):
-        ent = cache.job_blocks.get(key)
-        if ent is None or ent["v"] != jobs[key].flat_version:
+    # new names; scanning just those (dirty-middle jobs, changed nodes)
+    # keeps R stable below at O(churn) cost
+    for j in range(P, nJ - S):
+        ent = cache.job_blocks.get(job_keys[j])
+        if ent is None or ent["v"] != versions[j]:
             cache.ensure_names(t.init_resreq for t in job_tasks[j])
             cache.ensure_names(t.resreq for t in job_tasks[j])
-    for ni in nodes_list:
-        ent = cache.node_rows.get(ni.name)
+    # node layout key: parallel (epochs, versions) int arrays instead of a
+    # tuple-of-triples — flat_epoch is unique per NodeInfo instance, so it
+    # IS the position identity (names are only read for the rows that
+    # actually recompute), and the dirty scan is two numpy != reductions
+    node_epochs = np.array([ni.flat_epoch for ni in nodes_list],
+                           dtype=np.int64)
+    node_vers = np.array([ni.flat_version for ni in nodes_list],
+                         dtype=np.int64)
+    node_key = (node_epochs, node_vers)
+    old_nk = cache._node_key
+    if old_nk is not None and old_nk[0].shape[0] == n_nodes:
+        dirty = np.nonzero((node_epochs != old_nk[0])
+                           | (node_vers != old_nk[1]))[0].tolist()
+    else:
+        dirty = None  # resized/relaid layout: every row dirty
+    rows = cache.node_rows
+    for i in (dirty if dirty is not None else range(n_nodes)):
+        ni = nodes_list[i]
+        ent = rows.get(ni.name)
         if ent is None or ent["v"] != ni.flat_version:
             cache.ensure_names((ni.allocatable,))
     R = len(vocab)
@@ -536,105 +641,218 @@ def flatten_snapshot(
     T = bucket(max(n_tasks, 1))
     # +1 guarantees a padded (invalid) job slot: padded tasks point there so
     # the sequential solver's job-boundary logic never revisits a real job
-    J = bucket(len(job_keys) + 1)
+    J = bucket(nJ + 1)
+    shape_key = (R, T, J)
 
     arr = SnapshotArrays(vocab=vocab)
     arr.tasks_list = list(tasks_in_order)
     arr.nodes_list = nodes_list
-    arr.jobs_list = [jobs[k] for k in job_keys]
+    arr.jobs_list = jobs_seq
 
-    # -- task/job side, assembled from per-job cached blocks ----------------
-    # wholesale fast path: if no job changed and the task sequence is
-    # identical (verified via uid sequence + versions — list compares run at
-    # C speed), the previous session's assembled arrays are this session's
-    versions = [jobs[k].flat_version for k in job_keys]
-    uid_seq = [t.uid for t in tasks_in_order]
-    shape_key = (R, T, J)
-    tk = cache._task_key
-    if (tk is not None and tk[3] == shape_key and tk[0] == job_keys
-            and tk[1] == versions and tk[2] == uid_seq):
-        (arr.task_init_req, arr.task_req, arr.task_job, arr.task_rank,
-         arr.task_sig, arr.task_counts_ready, arr.task_valid,
-         arr.job_min, arr.job_ready_base, arr.job_queue, arr.job_valid,
-         sigs, sig_tasks, queue_index, queue_names) = cache._task_buf
-        return _finish(arr, cache, nodes_list, n_nodes, R, N, sigs,
-                       sig_tasks, queue_index, queue_names, queues)
+    # -- task/job side: persistent padded buffers, rewrite dirty rows only --
+    if asm is not None and asm["shape"] != shape_key:
+        asm = None
+        P = S = 0
+        oJ = 0
+        off_P = 0
+    if asm is not None:
+        bufs = asm["bufs"]
+        blocks_list = asm["blocks"]
+        mid_blocks = []
+        mid_uids = []
+        off = off_P
+        for j in range(P, nJ - S):
+            k = lens[j]
+            u = [t.uid for t in job_tasks[j]]
+            mid_uids.append(u)
+            ent = cache.job_block(jobs_seq[j], job_tasks[j], u)
+            mid_blocks.append(ent)
+            if k:
+                bufs["init"][off:off + k] = ent["init"]
+                bufs["req"][off:off + k] = ent["req"]
+                bufs["counts"][off:off + k] = ent["counts"]
+            off += k
+        end_mid = off
+        if nJ - S > P:
+            bufs["task_job"][off_P:end_mid] = np.repeat(
+                np.arange(P, nJ - S, dtype=np.int32),
+                np.asarray(lens[P:nJ - S], dtype=np.int64))
+        jmin, jready = bufs["job_min"], bufs["job_ready"]
+        jvalid = bufs["job_valid"]
+        for j in range(P, nJ - S):
+            ent = mid_blocks[j - P]
+            jmin[j] = ent["min"]
+            jready[j] = ent["ready"]
+            jvalid[j] = True
+        if S == 0:
+            # shape is unchanged but counts may differ: restore the padding
+            # invariants (rows >= n_tasks all-zero / invalid / padded-job)
+            old_n = asm["n_tasks"]
+            if old_n > n_tasks:
+                bufs["init"][n_tasks:old_n] = 0.0
+                bufs["req"][n_tasks:old_n] = 0.0
+                bufs["counts"][n_tasks:old_n] = False
+                bufs["sig"][n_tasks:old_n] = 0
+            bufs["task_job"][n_tasks:] = J - 1
+            bufs["valid"][:n_tasks] = True
+            bufs["valid"][n_tasks:] = False
+            if oJ > nJ:
+                jmin[nJ:oJ] = 0
+                jready[nJ:oJ] = 0
+                jvalid[nJ:oJ] = False
+                bufs["job_queue"][nJ:oJ] = 0
 
-    # per-job cached blocks -> padded columns via one concatenate per kind
-    # (numpy block copies instead of ~10 Python slice-assigns per job)
-    blocks = []
-    off = 0
-    for j, key in enumerate(job_keys):
-        k = len(job_tasks[j])
-        blocks.append(cache.job_block(jobs[key], job_tasks[j],
-                                      uid_seq[off:off + k]))
-        off += k
-    pad = T - n_tasks
+        # queue table: first-seen order over job blocks — unchanged when
+        # the dirty middle's queue sequence is unchanged (the common case)
+        new_queues = [b["queue"] for b in mid_blocks]
+        old_mid_q = asm["job_queues"][P:oJ - S]
+        asm["job_queues"][P:oJ - S] = new_queues
+        if new_queues != old_mid_q:
+            queue_index = {}
+            queue_names = []
+            jq = bufs["job_queue"]
+            for j, q in enumerate(asm["job_queues"]):
+                qi = queue_index.get(q)
+                if qi is None:
+                    qi = queue_index[q] = len(queue_names)
+                    queue_names.append(q)
+                jq[j] = qi
+            asm["queue_index"] = queue_index
+            asm["queue_names"] = queue_names
 
-    def cat2d(name):
-        parts = [b[name] for b in blocks]
-        if pad or not parts:
-            parts = parts + [np.zeros((pad, R), dtype=np.float32)]
-        return np.concatenate(parts, axis=0)
+        # signature table: same first-seen-order argument — if the middle's
+        # per-block signature sequence is unchanged, the global table (and
+        # every prefix/suffix task_sig row) is unchanged; only the middle
+        # rows re-map through the existing table
+        new_sig_seq = [b["sig_uniq"] for b in mid_blocks]
+        old_mid_sigs = asm["block_sigs"][P:oJ - S]
+        asm["block_sigs"][P:oJ - S] = new_sig_seq
+        blocks_list[P:oJ - S] = mid_blocks
+        if new_sig_seq == old_mid_sigs:
+            sigs = asm["sigs"]
+            sig_buf = bufs["sig"]
+            off = off_P
+            for i2, ent in enumerate(mid_blocks):
+                k = lens[P + i2]
+                if k:
+                    uniq = ent["sig_uniq"]
+                    if len(uniq) == 1:
+                        sig_buf[off:off + k] = sigs[uniq[0]]
+                    else:
+                        remap = np.array([sigs[s] for s in uniq], np.int32)
+                        sig_buf[off:off + k] = remap[ent["sig_local"]]
+                off += k
+        else:
+            asm["sigs"], asm["sig_tasks"] = _rebuild_sigs(
+                blocks_list, lens, bufs["sig"], n_tasks)
+        asm["task_uids"][P:oJ - S] = mid_uids
+        asm["task_lists"] = job_tasks
+        asm["job_keys"] = job_keys
+        asm["versions"] = versions
+        asm["lens"] = lens
+        asm["n_tasks"] = n_tasks
+    else:
+        # cold / reshaped: full assembly into fresh persistent buffers
+        bufs = {
+            "init": np.zeros((T, R), dtype=np.float32),
+            "req": np.zeros((T, R), dtype=np.float32),
+            "counts": np.zeros(T, dtype=bool),
+            "sig": np.zeros(T, dtype=np.int32),
+            "task_job": np.full(T, J - 1, dtype=np.int32),
+            "rank": np.arange(T, dtype=np.int32),
+            "valid": np.zeros(T, dtype=bool),
+            "job_min": np.zeros(J, dtype=np.int32),
+            "job_ready": np.zeros(J, dtype=np.int32),
+            "job_queue": np.zeros(J, dtype=np.int32),
+            "job_valid": np.zeros(J, dtype=bool),
+        }
+        blocks_list = []
+        task_uids = []
+        off = 0
+        for j in range(nJ):
+            k = lens[j]
+            u = [t.uid for t in job_tasks[j]]
+            task_uids.append(u)
+            ent = cache.job_block(jobs_seq[j], job_tasks[j], u)
+            blocks_list.append(ent)
+            if k:
+                bufs["init"][off:off + k] = ent["init"]
+                bufs["req"][off:off + k] = ent["req"]
+                bufs["counts"][off:off + k] = ent["counts"]
+            off += k
+        if n_tasks:
+            bufs["task_job"][:n_tasks] = np.repeat(
+                np.arange(nJ, dtype=np.int32),
+                np.asarray(lens, dtype=np.int64))
+            bufs["valid"][:n_tasks] = True
+        queue_index: Dict[str, int] = {}
+        queue_names: List[str] = []
+        job_queues: List[str] = []
+        jq = bufs["job_queue"]
+        for j, ent in enumerate(blocks_list):
+            bufs["job_min"][j] = ent["min"]
+            bufs["job_ready"][j] = ent["ready"]
+            bufs["job_valid"][j] = True
+            q = ent["queue"]
+            job_queues.append(q)
+            qi = queue_index.get(q)
+            if qi is None:
+                qi = queue_index[q] = len(queue_names)
+                queue_names.append(q)
+            jq[j] = qi
+        sigs, sig_tasks = _rebuild_sigs(blocks_list, lens, bufs["sig"],
+                                        n_tasks)
+        asm = {
+            "shape": shape_key, "bufs": bufs, "blocks": blocks_list,
+            "job_keys": job_keys, "versions": versions, "lens": lens,
+            "task_uids": task_uids, "task_lists": job_tasks,
+            "n_tasks": n_tasks,
+            "block_sigs": [b["sig_uniq"] for b in blocks_list],
+            "job_queues": job_queues,
+            "sigs": sigs, "sig_tasks": sig_tasks,
+            "queue_index": queue_index, "queue_names": queue_names,
+        }
+        cache._asm = asm
 
-    arr.task_init_req = cat2d("init")
-    arr.task_req = cat2d("req")
-    counts_parts = [b["counts"] for b in blocks]
-    if pad or not counts_parts:
-        counts_parts = counts_parts + [np.zeros(pad, dtype=bool)]
-    arr.task_counts_ready = np.concatenate(counts_parts)
-    lens = np.fromiter((len(ts) for ts in job_tasks), dtype=np.int64,
-                       count=len(job_tasks))
-    task_job = np.full(T, J - 1, dtype=np.int32)  # padded job slot
-    if n_tasks:
-        task_job[:n_tasks] = np.repeat(
-            np.arange(len(job_keys), dtype=np.int32), lens)
-    arr.task_job = task_job
-    arr.task_rank = np.arange(T, dtype=np.int32)
-    arr.task_valid = np.zeros(T, dtype=bool)
-    arr.task_valid[:n_tasks] = True
+    arr.task_init_req = bufs["init"]
+    arr.task_req = bufs["req"]
+    arr.task_counts_ready = bufs["counts"]
+    arr.task_sig = bufs["sig"]
+    arr.task_job = bufs["task_job"]
+    arr.task_rank = bufs["rank"]
+    arr.task_valid = bufs["valid"]
+    arr.job_min = bufs["job_min"]
+    arr.job_ready_base = bufs["job_ready"]
+    arr.job_queue = bufs["job_queue"]
+    arr.job_valid = bufs["job_valid"]
+    return _finish(arr, cache, nodes_list, n_nodes, R, N, node_key, dirty,
+                   asm["sigs"], asm["sig_tasks"], asm["queue_index"],
+                   asm["queue_names"], queues)
 
+
+def _rebuild_sigs(blocks_list, lens, sig_buf, n_tasks):
+    """Full signature-table rebuild: global first-seen indices over the
+    blocks in assembly order, task_sig rows written in place. The slow path
+    — the delta flatten takes it only when a dirty block changes the
+    per-block signature sequence."""
     sigs: Dict[str, int] = {}
     sig_tasks: List[TaskInfo] = []
-    sig_parts = []
-    for ent in blocks:
-        remap = np.empty(max(len(ent["sig_uniq"]), 1), dtype=np.int32)
-        for li, s in enumerate(ent["sig_uniq"]):
+    off = 0
+    for j, ent in enumerate(blocks_list):
+        k = lens[j]
+        uniq = ent["sig_uniq"]
+        remap = np.empty(max(len(uniq), 1), dtype=np.int32)
+        for li, s in enumerate(uniq):
             gi = sigs.get(s)
             if gi is None:
                 gi = sigs[s] = len(sig_tasks)
                 sig_tasks.append(ent["sig_reps"][li])
             remap[li] = gi
-        sig_parts.append(remap[ent["sig_local"]])
-    if pad or not sig_parts:
-        sig_parts.append(np.zeros(pad, dtype=np.int32))
-    arr.task_sig = np.concatenate(sig_parts)
-
-    arr.job_min = np.zeros(J, dtype=np.int32)
-    arr.job_ready_base = np.zeros(J, dtype=np.int32)
-    arr.job_queue = np.zeros(J, dtype=np.int32)
-    arr.job_valid = np.zeros(J, dtype=bool)
-    queue_index: Dict[str, int] = {}
-    queue_names: List[str] = []
-    for j, ent in enumerate(blocks):
-        arr.job_min[j] = ent["min"]
-        arr.job_ready_base[j] = ent["ready"]
-        arr.job_valid[j] = True
-        q = ent["queue"]
-        qi = queue_index.get(q)
-        if qi is None:
-            qi = queue_index[q] = len(queue_names)
-            queue_names.append(q)
-        arr.job_queue[j] = qi
-
-    cache._task_key = (job_keys, versions, uid_seq, shape_key)
-    cache._task_buf = (arr.task_init_req, arr.task_req, arr.task_job,
-                       arr.task_rank, arr.task_sig, arr.task_counts_ready,
-                       arr.task_valid, arr.job_min, arr.job_ready_base,
-                       arr.job_queue, arr.job_valid, sigs, sig_tasks,
-                       queue_index, queue_names)
-    return _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
-                   queue_index, queue_names, queues)
+        if k:
+            sig_buf[off:off + k] = remap[ent["sig_local"]]
+        off += k
+    sig_buf[n_tasks:] = 0
+    return sigs, sig_tasks
 
 
 def _bulk_node_rows(cache, fast, buf, R: int) -> None:
@@ -684,20 +902,48 @@ def _bulk_node_rows(cache, fast, buf, R: int) -> None:
     for j, (_, ni) in enumerate(fast):
         rows[ni.name] = {
             "v": ni.flat_version, "e": ni.flat_epoch, "R": R,
+            "sv": ni.spec_version,
             "idle": idle[j], "used": used[j], "extra": extra[j],
             "alloc": alloc[j], "npods": int(npods[j]),
             "maxp": int(maxp[j])}
 
 
-def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
-            queue_index, queue_names, queues):
+def _finish(arr, cache, nodes_list, n_nodes, R, N, node_key, dirty,
+            sigs, sig_tasks, queue_index, queue_names, queues):
     vocab = arr.vocab
     # -- node side: persistent buffer, rewrite only changed rows ------------
-    node_key = tuple((ni.name, ni.flat_epoch, ni.flat_version)
-                     for ni in nodes_list)
+    # node_key and the dirty positions were computed by flatten_snapshot's
+    # single pre-pass over the node list (dirty is None when the previous
+    # layout doesn't line up, i.e. every row is dirty)
     buf = cache._node_buf
     reusable = (buf is not None and buf["R"] == R and buf["N"] == N
-                and len(cache._node_key) == n_nodes)
+                and dirty is not None)
+
+    # spec-keyed signature tuple: rebuilt only when a changed node's spec
+    # actually moved (name/epoch replacement or a spec_version bump) —
+    # pure accounting churn reuses the cached tuple
+    sk = cache._spec_key
+    spec_stale = not reusable or sk is None or len(sk) != n_nodes
+    if not spec_stale:
+        # a dirty position whose epoch moved is a replaced node; one whose
+        # spec_version moved is a respec'd node — either forces a rebuild,
+        # pure accounting bumps (flat_version only) do not
+        old_epochs = cache._node_key[0]
+        rows = cache.node_rows
+        for i in dirty:
+            ni = nodes_list[i]
+            if node_key[0][i] != old_epochs[i]:
+                spec_stale = True
+                break
+            ent = rows.get(ni.name)
+            if ent is None or ent["sv"] != ni.spec_version:
+                spec_stale = True
+                break
+    if spec_stale:
+        sk = tuple((ni.name, ni.flat_epoch, ni.spec_version)
+                   for ni in nodes_list)
+        cache._spec_key = sk
+
     if not reusable:
         buf = {
             "R": R, "N": N,
@@ -710,12 +956,9 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
             "valid": np.zeros(N, dtype=bool),
         }
         buf["valid"][:n_nodes] = True
-        old_key = ()
+        pending = list(enumerate(nodes_list))
     else:
-        old_key = cache._node_key
-    pending = [(i, ni) for i, ni in enumerate(nodes_list)
-               if not (reusable and i < len(old_key)
-                       and old_key[i] == node_key[i])]
+        pending = [(i, nodes_list[i]) for i in dirty]
     # cold-path vectorization (first cycle / full reship): scalar-free
     # nodes bulk-extract cpu+mem via one list comprehension per column
     # and land in the buffer as fancy-indexed scatters — the per-node
@@ -764,13 +1007,15 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
     if not sig_tasks:
         arr.sig_masks[:, :] = True
     # label/taint-only masks survive resource-accounting churn: they key on
-    # spec versions; only port-aware masks key on the full node epoch
-    spec_key = tuple((ni.name, ni.flat_epoch, ni.spec_version)
-                     for ni in nodes_list)
+    # spec versions (the cached sk tuple); only port-aware masks key on the
+    # full accounting state (epoch/version arrays serialized to bytes so
+    # the cached-row compare is a memcmp, not 2k tuple compares)
+    spec_key = sk
+    acct_key = (node_key[0].tobytes(), node_key[1].tobytes())
     for s, s_idx in sigs.items():
         # (even the unconstrained "" signature must run the node loop:
         # untolerated NoSchedule taints block constraint-free pods too)
-        row_key = node_key if sig_tasks[s_idx].pod.ports() else spec_key
+        row_key = acct_key if sig_tasks[s_idx].pod.ports() else spec_key
         cached = cache.sig_rows.get(s)
         if cached is not None and cached[0] == row_key \
                 and cached[1].shape[0] == N:
@@ -827,6 +1072,5 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, sigs, sig_tasks,
     arr.scalar_dim_mask = np.zeros(R, dtype=bool)
     arr.scalar_dim_mask[2:] = True
 
-    cache.sweep({j.uid for j in arr.jobs_list},
-                {ni.name for ni in nodes_list}, sigs)
+    cache.sweep(arr.jobs_list, nodes_list, sigs)
     return arr

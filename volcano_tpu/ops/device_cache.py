@@ -224,9 +224,16 @@ class PackedDeviceCache:
     def params_device(self, params: dict) -> dict:
         import jax
 
-        blob = b"".join(
-            k.encode() + np.asarray(v).tobytes()
-            for k, v in sorted(params.items()))
+        def _ent(k, v):
+            # delimited key + dtype + shape + content: without these two
+            # distinct params dicts whose concatenated bytes happen to
+            # line up (or whose arrays share bytes but not shape/dtype)
+            # could collide and serve stale device params
+            a = np.asarray(v)
+            return b"\0".join((k.encode(), str(a.dtype).encode(),
+                               repr(a.shape).encode(), a.tobytes())) + b"\1"
+
+        blob = b"".join(_ent(k, v) for k, v in sorted(params.items()))
         if blob == getattr(self, "_params_blob", None):
             return self._params_dev
         self._params_dev = {k: jax.device_put(np.asarray(v))

@@ -225,6 +225,60 @@ def _hdrf_core(a, rank):
             sat = jnp.where(tgt, sat_p, sat)
         return share, sat
 
+    # doubled-id tree recursion: the progressive cap needs the live state
+    # AND the all-eligible-placed endpoint every round; stacking the two
+    # problems on disjoint segment-id ranges [0,H) / [H,2H) runs both
+    # through ONE pass of segment reductions instead of two (same per-
+    # segment element sets, so the results match the separate recursions)
+    parent2 = jnp.concatenate([parent, parent + H])
+    depth2 = jnp.concatenate([depth, depth])
+    is_leaf2 = jnp.concatenate([is_leaf, is_leaf])
+    leaf_req2 = jnp.concatenate([leaf_req, leaf_req], axis=0)
+
+    def tree_state_pair(jobres, jobres_full):
+        """(share[H], sat[H], share_full[H]) — tree_state evaluated at both
+        allocations in one fused recursion."""
+        R_ = total.shape[0]
+        alloc = jnp.zeros((2 * H, R_), jnp.float32)
+        alloc = alloc.at[job_leaf].add(a["job_drf_allocated"] + jobres)
+        alloc = alloc.at[job_leaf + H].add(
+            a["job_drf_allocated"] + jobres_full)
+        ta_a = a["hdrf_total_allocated"] + jnp.sum(jobres, axis=0)
+        ta_b = a["hdrf_total_allocated"] + jnp.sum(jobres_full, axis=0)
+        demanding = jnp.concatenate([
+            jnp.broadcast_to((ta_a < total)[None, :], (H, R_)),
+            jnp.broadcast_to((ta_b < total)[None, :], (H, R_))])
+
+        share = jnp.where(is_leaf2, share_of(alloc), 0.0)
+        sat_dim = (((alloc != 0.0) & (leaf_req2 != 0.0)
+                    & (alloc >= leaf_req2))
+                   | (~demanding & (leaf_req2 != 0.0)))
+        sat = is_leaf2 & jnp.any(sat_dim, axis=1)
+
+        for d in range(D - 1, -1, -1):  # static unroll, small depth
+            child = depth2 == (d + 1)
+            live = child & (share > 0.0) & ~sat
+            mdr = jax.ops.segment_min(
+                jnp.where(live, share, jnp.inf), parent2,
+                num_segments=2 * H)
+            scale = jnp.where(
+                sat, 1.0, mdr[parent2] / jnp.maximum(share, 1e-12))
+            contrib = jnp.where((child & (share > 0.0))[:, None],
+                                alloc * scale[:, None], 0.0)
+            alloc_p = jax.ops.segment_sum(contrib, parent2,
+                                          num_segments=2 * H)
+            sat_p = jax.ops.segment_min(
+                jnp.where(child, sat.astype(jnp.int32), 1), parent2,
+                num_segments=2 * H) > 0
+            has_child = jax.ops.segment_max(
+                child.astype(jnp.int32), parent2,
+                num_segments=2 * H) > 0
+            tgt = (depth2 == d) & ~is_leaf2 & has_child
+            alloc = jnp.where(tgt[:, None], alloc_p, alloc)
+            share = jnp.where(tgt, share_of(alloc_p), share)
+            sat = jnp.where(tgt, sat_p, sat)
+        return share[:H], sat[:H], share[H:]
+
     def rank_from(share, sat):
         # per-level lexicographic job key: level 1 is most significant;
         # within a level saturation dominates share/weight
@@ -386,7 +440,7 @@ def _hdrf_core(a, rank):
             still = still & (~present_j[task_job] | ok)
         return still
 
-    return tree_state, rank_from, cap_from
+    return tree_state, tree_state_pair, rank_from, cap_from
 
 
 def hdrf_state(a, rank):
@@ -401,19 +455,18 @@ def hdrf_state(a, rank):
     """
 
     import jax
-    import jax.numpy as jnp
 
-    tree_state, rank_from, cap_from = _hdrf_core(a, rank)
+    _, tree_state_pair, rank_from, cap_from = _hdrf_core(a, rank)
     J = a["job_min"].shape[0]
 
     def rank_and_cap(eligible, jobres):
-        share, sat = tree_state(jobres)
-        # second tree evaluation with every eligible increment placed:
-        # the cap's linearization endpoint (see cap_from)
+        # live state + the every-eligible-increment-placed endpoint (the
+        # cap's linearization, see cap_from), fused into one doubled-id
+        # tree recursion instead of two separate passes per round
         pending = jax.ops.segment_sum(
             a["task_req"] * eligible[:, None], a["task_job"],
             num_segments=J)
-        share_full, _ = tree_state(jobres + pending)
+        share, sat, share_full = tree_state_pair(jobres, jobres + pending)
         r_rank, job_pos = rank_from(share, sat)
         still = cap_from(share, sat, share_full, job_pos, eligible)
         return r_rank, still
@@ -425,7 +478,7 @@ def hdrf_rank_state(a, rank):
     """Device-side: returns hdrf_rank(jobres) -> [T] int32 dense ranks
     (the re-rank alone, no cap — comparator parity tests and consumers
     that manage their own eligibility)."""
-    tree_state, rank_from, _ = _hdrf_core(a, rank)
+    tree_state, _, rank_from, _ = _hdrf_core(a, rank)
 
     def hdrf_rank(jobres):
         share, sat = tree_state(jobres)

@@ -313,7 +313,7 @@ def queue_cap_state(a, rank, thr, total, ease_unrequested: bool = True):
 
 
 def _queue_cap_mask(eligible, task_queue, req, qrem, thr, scalar_mask,
-                    q_perm, q_seg_start):
+                    q_perm, q_seg_start, s_q=None, s_req_raw=None):
     """Per-round queue admission cap: among eligible tasks in (queue, rank)
     order, a task passes iff its queue's running prefix of *eligible*
     requests + its own request still fits the queue's remaining deserved
@@ -324,18 +324,24 @@ def _queue_cap_mask(eligible, task_queue, req, qrem, thr, scalar_mask,
     q_perm/q_seg_start are the static (queue, rank) sort and its queue
     segment boundaries — task_queue and rank never change within a solve,
     so the sort is hoisted out of the round loop (one argsort per solve
-    instead of one per round); only the eligibility mask varies here."""
+    instead of one per round); only the eligibility mask varies here.
+    s_q/s_req_raw are the sorted task_queue/req gathers — also static for
+    a static q_perm, so callers hoist them too (live-DRF callers, whose
+    q_perm changes per round, leave them None)."""
     T = req.shape[0]
-    s_q = task_queue[q_perm]
+    if s_q is None:
+        s_q = task_queue[q_perm]
+    if s_req_raw is None:
+        s_req_raw = req[q_perm]
     s_act = eligible[q_perm]
     s_rem = qrem[s_q]
     # a task whose own request can never fit the queue's remaining deserve
     # must not hold budget in the prefix — the sequential reference only
     # charges the queue on actual placement, so a too-big task ahead in
     # rank order doesn't starve feasible tasks behind it
-    s_fits_alone = le_fits(req[q_perm], s_rem, thr, scalar_mask,
-                           ignore_req=req[q_perm]) & s_act
-    s_req = req[q_perm] * s_fits_alone[:, None]
+    s_fits_alone = le_fits(s_req_raw, s_rem, thr, scalar_mask,
+                           ignore_req=s_req_raw) & s_act
+    s_req = s_req_raw * s_fits_alone[:, None]
     prefix = _segment_prefix(s_req, q_seg_start)
     ok_sorted = le_fits(prefix + s_req, s_rem, thr, scalar_mask,
                         ignore_req=s_req) & s_fits_alone
@@ -596,10 +602,15 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
         Q, deserved, task_queue, q_perm, q_seg_start = queue_cap_state(
             a, rank, thr, total, ease_unrequested=work_conserving)
         qalloc0 = a["queue_allocated"]
+        # static-sort gathers hoisted out of the round loop (the live-DRF
+        # re-sorted path recomputes them per round inside the mask)
+        qs_q = task_queue[q_perm]
+        qs_req = a["task_req"][q_perm]
     else:
         task_queue = None
         deserved = None
         q_perm = q_seg_start = None
+        qs_q = qs_req = None
         qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
     if use_drf_order:
@@ -678,11 +689,15 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 # because capacity is otherwise idle)
                 bound = deserved if capped else a["queue_capability"]
                 qrem = jnp.maximum(bound - qalloc, 0.0)
-                qp = (jnp.lexsort((r_rank, task_queue)) if use_drf_order
-                      else q_perm)
-                eligible = eligible & _queue_cap_mask(
-                    eligible, task_queue, a["task_req"], qrem, thr,
-                    scalar_mask, qp, q_seg_start)
+                if use_drf_order:
+                    qp = jnp.lexsort((r_rank, task_queue))
+                    eligible = eligible & _queue_cap_mask(
+                        eligible, task_queue, a["task_req"], qrem, thr,
+                        scalar_mask, qp, q_seg_start)
+                else:
+                    eligible = eligible & _queue_cap_mask(
+                        eligible, task_queue, a["task_req"], qrem, thr,
+                        scalar_mask, q_perm, q_seg_start, qs_q, qs_req)
             if use_fused:
                 new_assign, debit, pod_inc = _admission_round_fused(
                     eligible, a, avail, used_now, sig_feas, sig_i8,
@@ -781,7 +796,7 @@ def solve_allocate(arrays: Dict[str, jnp.ndarray],
                 qrem_now = jnp.maximum(deserved - qalloc_c, 0.0)
                 elig_capped = _queue_cap_mask(
                     rem, task_queue, a["task_req"], qrem_now, thr,
-                    scalar_mask, q_perm, q_seg_start)
+                    scalar_mask, q_perm, q_seg_start, qs_q, qs_req)
                 capped_out = jnp.any(rem & ~elig_capped)
                 st = phase_rounds(st, use_future=False, capped=False,
                                   gate=capped_out)

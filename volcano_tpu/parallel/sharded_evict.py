@@ -96,10 +96,17 @@ def _solve_sharded(arrays, victims, score_params, mesh,
     params_spec = {k: (P("n") if k == "node_static" else P())
                    for k in score_params}
 
+    # static D=1 fast path: every all_gather degrades to identity and is
+    # skipped at trace time (same contract as parallel/sharded_solver.py)
+    D1 = D == 1
+
     def kernel(a, v, sp):
-        axis_idx = jax.lax.axis_index("n")
         n_loc = a["node_idle"].shape[0]
-        my_base = axis_idx * n_loc
+        my_base = jnp.int32(0) if D1 \
+            else jax.lax.axis_index("n") * n_loc
+
+        def gather(x):
+            return x if D1 else jax.lax.all_gather(x, "n", tiled=True)
         v_req = v["v_req"]
         v_node_loc = v["v_node"] - my_base          # local node index
         v_valid = v["v_valid"]
@@ -151,11 +158,10 @@ def _solve_sharded(arrays, victims, score_params, mesh,
 
             # replicated spread over gathered [N] vectors (same math as
             # ops/evict.py spread_counts)
-            score_all = jax.lax.all_gather(job_score_loc[j], "n",
-                                           tiled=True)
-            m_all = jax.lax.all_gather(m_all_loc, "n", tiled=True)
-            f_all = jax.lax.all_gather(f_loc, "n", tiled=True)
-            cap_extra = jax.lax.all_gather(cap_loc, "n", tiled=True)
+            score_all = gather(job_score_loc[j])
+            m_all = gather(m_all_loc)
+            f_all = gather(f_loc)
+            cap_extra = gather(cap_loc)
 
             total = jnp.sum(m_all).astype(jnp.int32)
             satisfied = (total >= need[j]) if stop_at_need \
@@ -205,7 +211,7 @@ def _solve_sharded(arrays, victims, score_params, mesh,
         carry, _ = jax.lax.scan(step, init, jnp.arange(J))
         future, alive, evby, assigned, jalloc = carry
         # gather local victim verdicts into the sharded global layout
-        evby_all = jax.lax.all_gather(evby, "n", tiled=True)
+        evby_all = gather(evby)
         return assigned, evby_all, jalloc
 
     mapped = shard_map(

@@ -121,10 +121,17 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
     params_spec = {k: (P("n") if k == "node_static" else P())
                    for k in score_params}
 
+    # D == 1 is a static property of the mesh: every collective below
+    # degrades to identity, so they are skipped at TRACE time — the
+    # compiled 1-device program contains no all_gather/psum/pmax at all
+    # and the shard_map wrapper costs nothing beyond the call itself
+    # (tests/test_parallel.py asserts the jaxpr is collective-free)
+    D1 = D == 1
+
     def kernel(a, sp):
-        axis_idx = jax.lax.axis_index("n")
         n_loc = a["node_idle"].shape[0]
-        my_base = axis_idx * n_loc
+        my_base = jnp.int32(0) if D1 \
+            else jax.lax.axis_index("n") * n_loc
         sig_feas = a["sig_masks"][a["task_sig"]] & a["node_valid"][None, :]
         if use_fused:
             from ..ops.pallas_kernels import fused_choice, fused_setup
@@ -133,13 +140,17 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 sp, a["task_init_req"].shape[1])
 
         if use_queue_cap:
-            total = jax.lax.psum(
-                jnp.sum(a["node_alloc"]
-                        * a["node_valid"][:, None].astype(jnp.float32),
-                        axis=0), "n")
+            total_loc = jnp.sum(
+                a["node_alloc"]
+                * a["node_valid"][:, None].astype(jnp.float32), axis=0)
+            total = total_loc if D1 else jax.lax.psum(total_loc, "n")
             Q, deserved, task_queue, q_perm, q_seg_start = queue_cap_state(
                 a, rank, thr, total)
             qalloc0 = a["queue_allocated"]
+            # static-sort gathers hoisted out of the round loop (see
+            # ops/solver.py — the live-DRF path re-sorts per round)
+            qs_q = task_queue[q_perm]
+            qs_req = a["task_req"][q_perm]
         else:
             qalloc0 = jnp.zeros((1, a["node_idle"].shape[1]), jnp.float32)
 
@@ -198,14 +209,18 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     + my_base
                 node_score_loc = jnp.max(masked, axis=0)          # [N_loc]
 
-            # personal best across devices
-            vals = jax.lax.all_gather(loc_val, "n")               # [D,T]
-            idxs = jax.lax.all_gather(loc_idx, "n")               # [D,T]
-            best_dev = jnp.argmax(vals, axis=0)                   # [T]
-            personal = jnp.take_along_axis(
-                idxs, best_dev[None, :], axis=0)[0]               # [T]
-            has_any = jnp.max(vals, axis=0) > NEG / 2
-            personal = jnp.where(has_any, personal, -1)
+            # personal best across devices (D=1: the local best IS global)
+            if D1:
+                has_any = loc_val > NEG / 2
+                personal = jnp.where(has_any, loc_idx, -1)
+            else:
+                vals = jax.lax.all_gather(loc_val, "n")           # [D,T]
+                idxs = jax.lax.all_gather(loc_idx, "n")           # [D,T]
+                best_dev = jnp.argmax(vals, axis=0)               # [T]
+                personal = jnp.take_along_axis(
+                    idxs, best_dev[None, :], axis=0)[0]           # [T]
+                has_any = jnp.max(vals, axis=0) > NEG / 2
+                personal = jnp.where(has_any, personal, -1)
 
             if herd_mode in ("pack", "spread"):
                 n_elig = jnp.maximum(jnp.sum(eligible), 1)
@@ -222,9 +237,13 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     slots_loc, (a["node_max_pods"] - npods).astype(jnp.float32))
                 slots_loc = jnp.clip(slots_loc, 0.0, float(T))
 
-                node_score = jax.lax.all_gather(
-                    node_score_loc, "n", tiled=True)              # [N]
-                slots = jax.lax.all_gather(slots_loc, "n", tiled=True)
+                if D1:
+                    node_score, slots = node_score_loc, slots_loc
+                else:
+                    node_score = jax.lax.all_gather(
+                        node_score_loc, "n", tiled=True)          # [N]
+                    slots = jax.lax.all_gather(slots_loc, "n",
+                                               tiled=True)
                 has_slot = slots > 0
                 order = jnp.argsort(-jnp.where(has_slot, node_score, NEG))
                 pos = jnp.cumsum(eligible.astype(jnp.int32)) - 1
@@ -252,7 +271,8 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     t_ok_loc = jnp.take_along_axis(
                         feas, jnp.clip(t_loc, 0, n_loc - 1)[:, None],
                         axis=1)[:, 0] & mine
-                t_ok = jax.lax.psum(t_ok_loc.astype(jnp.int32), "n") > 0
+                t_ok = t_ok_loc if D1 else (
+                    jax.lax.psum(t_ok_loc.astype(jnp.int32), "n") > 0)
                 choice = jnp.where(t_ok, target, personal)
             else:
                 choice = personal
@@ -290,8 +310,9 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                 admit.astype(jnp.int32), jnp.maximum(c_loc, 0),
                 num_segments=n_loc)
             # global admitted assignment: each task admitted on one device
-            new_assign = jax.lax.pmax(
-                jnp.where(admit, choice, -1), "n")                # [T]
+            new_assign = jnp.where(admit, choice, -1)
+            if not D1:
+                new_assign = jax.lax.pmax(new_assign, "n")        # [T]
             return new_assign, debit, pod_inc
 
         def phase_rounds(st, use_future, capped=True):
@@ -324,15 +345,17 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                                 eligible.astype(jnp.float32),
                                 pods_ok_v.astype(jnp.float32), sig_i8,
                                 fused_pars, score_families)
-                            placeable = jax.lax.pmax(
-                                best_s0, "n") > NEG * 0.5
+                            if not D1:
+                                best_s0 = jax.lax.pmax(best_s0, "n")
+                            placeable = best_s0 > NEG * 0.5
                         else:
                             feas0 = (fits_matrix(a["task_init_req"],
                                                  avail, thr, scalar_mask)
                                      & sig_feas & pods_ok_v[None, :])
-                            placeable = jax.lax.psum(
-                                jnp.any(feas0, axis=1).astype(jnp.int32),
-                                "n") > 0
+                            any_loc = jnp.any(feas0, axis=1)
+                            placeable = any_loc if D1 else (
+                                jax.lax.psum(any_loc.astype(jnp.int32),
+                                             "n") > 0)
                         r_rank, eligible = hdrf_rank_cap(
                             eligible & placeable, jobres)
                     else:
@@ -344,11 +367,16 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
                     # overflow pass relaxes deserved, never capability
                     bound = deserved if capped else a["queue_capability"]
                     qrem = jnp.maximum(bound - qalloc, 0.0)
-                    qp = (jnp.lexsort((r_rank, task_queue))
-                          if use_drf_order else q_perm)
-                    eligible = eligible & _queue_cap_mask(
-                        eligible, task_queue, a["task_req"], qrem, thr,
-                        scalar_mask, qp, q_seg_start)
+                    if use_drf_order:
+                        qp = jnp.lexsort((r_rank, task_queue))
+                        eligible = eligible & _queue_cap_mask(
+                            eligible, task_queue, a["task_req"], qrem,
+                            thr, scalar_mask, qp, q_seg_start)
+                    else:
+                        eligible = eligible & _queue_cap_mask(
+                            eligible, task_queue, a["task_req"], qrem,
+                            thr, scalar_mask, q_perm, q_seg_start,
+                            qs_q, qs_req)
                 choice = choose(eligible, avail, idle, npods, feas0)
                 new_assign, debit, pod_inc = admit_local(
                     choice, avail, npods, r_rank)
@@ -474,3 +502,35 @@ def solve_allocate_sharded(arrays: Dict[str, jnp.ndarray],
         {k: a[k] for k in in_specs}, dict(score_params))
     return SolveResult(assigned=assigned, kind=kind, job_ready=job_ready,
                        rounds=rounds)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "layout", "mesh", "max_rounds", "max_gang_iters", "herd_mode",
+    "score_families", "use_queue_cap", "use_drf_order", "use_hdrf_order",
+    "fused"))
+def solve_allocate_sharded_packed2d(f2d, i2d, layout,
+                                    score_params, mesh: Mesh,
+                                    max_rounds: int = 64,
+                                    max_gang_iters: int = 12,
+                                    herd_mode: str = "pack",
+                                    score_families=("binpack",),
+                                    use_queue_cap: bool = False,
+                                    use_drf_order: bool = False,
+                                    use_hdrf_order: bool = False,
+                                    fused: str = "auto") -> SolveResult:
+    """Sharded solve over the chunked device-resident buffers kept by
+    ops.device_cache.PackedDeviceCache: the unpack slices fuse away on
+    device, so a sharded deployment ships only dirty chunks per session
+    exactly like the single-device path — no host re-upload and, at D=1,
+    no re-sharding of the resident buffers on entry."""
+    from ..ops.solver import _unpack
+
+    nf = max(off + size for k, kind, off, size, shape in layout
+             if kind == "f")
+    ni = max(off + size for k, kind, off, size, shape in layout
+             if kind != "f")
+    arrays = _unpack(f2d.reshape(-1)[:nf], i2d.reshape(-1)[:ni], layout)
+    return solve_allocate_sharded(arrays, score_params, mesh, max_rounds,
+                                  max_gang_iters, herd_mode,
+                                  score_families, use_queue_cap,
+                                  use_drf_order, use_hdrf_order, fused)

@@ -17,7 +17,11 @@ def validate_pod(verb: str, pod: Pod, cluster,
                  opts=None) -> Pod:
     if verb != "create":
         return pod
-    if pod.scheduler_name != "volcano":
+    # scope to the CONFIGURED scheduler name (admit_pod.go checks the
+    # configured scheduler-names list): under --scheduler-name the gate
+    # must follow the renamed control plane, not the literal default
+    scheduler_name = opts.scheduler_name if opts is not None else "volcano"
+    if pod.scheduler_name != scheduler_name:
         return pod
     pg_name = (pod.annotations or {}).get(POD_GROUP_ANNOTATION)
     if not pg_name:

@@ -17,8 +17,13 @@ from .router import AdmissionService, register_admission_service
 def validate_queue(verb: str, queue: Queue, cluster,
                    opts=None) -> Queue:
     if verb == "delete":
-        if queue.name == "default":
-            raise AdmissionError("`default` queue can not be deleted")
+        # protect the CONFIGURED default queue (the reference protects its
+        # configured default): with --default-queue=team-x, deleting
+        # team-x would break every queue-less job submission
+        default_queue = opts.default_queue if opts is not None else "default"
+        if queue.name == default_queue:
+            raise AdmissionError(
+                f"`{default_queue}` queue can not be deleted")
         for pg in cluster.list("podgroups"):
             if (pg.spec.queue or "default") == queue.name:
                 raise AdmissionError(
