@@ -352,18 +352,73 @@ class AllocateAction(Action):
                 use_drf_order=use_drf_order,
                 use_hdrf_order=use_hdrf_order,
                 work_conserving=work_conserving)
+        # ------------------------------------------------------------------
+        # dispatch/collect split: the jitted solve above is an ASYNC
+        # dispatch (res holds device futures), so the host is free until
+        # the compact readback below actually blocks. Spend that window on
+        # work that previously serialized after the device finished:
+        # replay preparation (the node-name table the Statement replay
+        # indexes), the bucket-prewarm occupancy check (ops.precompile),
+        # and a young-generation gc pass (collection is disabled during
+        # the cycle — see Scheduler.run_once — so this drains the nursery
+        # for free while the device solves). pipeline_solver=False keeps
+        # the strictly serial order for parity testing.
+        # ------------------------------------------------------------------
+        pipelined = bool(getattr(ssn, "pipeline_solver", True))
+        node_names = None
+        statements = None
+        if res is not None and pipelined:
+            t1 = _time.perf_counter()
+            node_names = [n.name for n in arr.nodes_list]
+            # Statement construction is pure (no session registration
+            # until ops are recorded), so the replay's per-job statements
+            # can be built before the results exist
+            statements = [ssn.statement(defer_events=True)
+                          for _ in job_order]
+            self._observe_prewarm(ssn, arr, dc)
+            import jax
+            if jax.default_backend() != "cpu":
+                # young-gen GC only when the solve runs on a real
+                # accelerator: there the readback wait is genuine host
+                # idle, while on the CPU backend host and "device" share
+                # cores and the collection would just lengthen the cycle
+                import gc
+                gc.collect(0)
+            timing["overlap_ms"] = (_time.perf_counter() - t1) * 1e3
         if res is not None:
             # one int16 readback instead of two int32 ones: the tunnel to a
             # remote chip is bandwidth-poor, so the result wire format
             # matters (the sidecar path already returned host arrays)
             from ..ops.solver import COMPACT_KIND_SHIFT, decode_compact
             t1 = _time.perf_counter()
-            if arr.N <= (1 << COMPACT_KIND_SHIFT):
-                assigned, kind = decode_compact(res.compact)
-            else:  # >16k nodes: node index overflows the int16 packing
-                assigned = np.asarray(res.assigned)
-                kind = np.asarray(res.kind)
+            try:
+                if arr.N <= (1 << COMPACT_KIND_SHIFT):
+                    assigned, kind = decode_compact(res.compact)
+                else:  # >16k nodes: node index overflows int16 packing
+                    assigned = np.asarray(res.assigned)
+                    kind = np.asarray(res.kind)
+            except Exception:
+                # async-collect failure: the error surfaces HERE, after a
+                # donated-buffer dispatch already commit()ed what are now
+                # poisoned device buffers — drop the device cache so the
+                # next session re-ships in full instead of solving on (or
+                # scattering into) invalid buffers, and finish THIS
+                # session through the host oracle so a device fault costs
+                # one slow cycle, not a scheduling gap
+                log.exception("solver collect failed; resetting device "
+                              "cache and falling back to the host loop")
+                if dc is not None:
+                    dc.reset()
+                timing["host_fallback"] = 1.0
+                ssn.solver_options["_post_host_jobs"] = []
+                self._execute_host(ssn)
+                return
             timing["readback_ms"] = (_time.perf_counter() - t1) * 1e3
+            if not pipelined:
+                # serial mode still pre-warms (after the readback), so
+                # turning the overlap off doesn't also disable the
+                # compile-stall protection
+                self._observe_prewarm(ssn, arr, dc)
         timing["solve_ms"] = (_time.perf_counter() - t0) * 1e3
         t0 = _time.perf_counter()
 
@@ -382,7 +437,8 @@ class AllocateAction(Action):
             flush_bulk_commit
         acc = begin_bulk_commit(ssn)
         try:
-            self._replay(ssn, arr, job_order, assigned, kind)
+            self._replay(ssn, arr, job_order, assigned, kind, node_names,
+                         statements)
         finally:
             # exception-safe: jobs already committed into the window MUST
             # still get their cache binds + events even if a later job's
@@ -390,11 +446,31 @@ class AllocateAction(Action):
             flush_bulk_commit(ssn, acc)
         timing["replay_ms"] = (_time.perf_counter() - t0) * 1e3
 
-    def _replay(self, ssn, arr, job_order, assigned, kind) -> None:
-        nodes_list = arr.nodes_list
+    @staticmethod
+    def _observe_prewarm(ssn, arr, dc) -> None:
+        """Feed the bucket prewarmer (ops.precompile.BucketPrewarmer) the
+        live occupancy; a trigger only spawns a daemon thread, so this is
+        safe inside the dispatch/collect overlap window."""
+        pw = getattr(ssn, "prewarmer", None)
+        if pw is None or dc is None:
+            return
+        try:
+            pw.observe(arr, dc)
+        except Exception:  # noqa: BLE001 — prewarm is advisory
+            log.exception("bucket prewarm observe failed")
+
+    def _replay(self, ssn, arr, job_order, assigned, kind,
+                node_names: Optional[List[str]] = None,
+                statements: Optional[List] = None) -> None:
+        # node-name table + per-job statements: prepped in the
+        # dispatch/collect overlap window when the pipeline is on,
+        # rebuilt here otherwise
+        if node_names is None:
+            node_names = [n.name for n in arr.nodes_list]
         idx = 0
-        for job, tasks in job_order:
-            stmt = ssn.statement(defer_events=True)
+        for j, (job, tasks) in enumerate(job_order):
+            stmt = statements[j] if statements is not None \
+                else ssn.statement(defer_events=True)
             pairs = []
             for task in tasks:
                 t_idx = idx
@@ -405,7 +481,7 @@ class AllocateAction(Action):
                     fe.set_error(ALL_NODES_UNAVAILABLE)
                     job.nodes_fit_errors[task.key] = fe
                     continue
-                node_name = nodes_list[node_idx].name
+                node_name = node_names[node_idx]
                 if kind[t_idx] == 0:
                     pairs.append((task, node_name))
                     continue

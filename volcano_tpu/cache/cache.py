@@ -294,6 +294,11 @@ class SchedulerCache:
         # when set, allocate ships snapshots to the solver process instead
         # of running the kernel in-process
         self.sidecar = None
+        # compile-and-dispatch pipeline (ops.precompile): the Scheduler
+        # installs a BucketPrewarmer here when enabled; pipeline_solver
+        # gates the allocate action's dispatch/collect overlap
+        self.prewarmer = None
+        self.pipeline_solver = True
 
         # job uid -> flat_version reflected by the last successful status
         # write; the job updater's skip-if-untouched check compares against

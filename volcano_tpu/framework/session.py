@@ -111,6 +111,11 @@ class Session:
                                             None) or {}
         self.device_cache = getattr(cache, "device_cache", None)
         self.sidecar = getattr(cache, "sidecar", None)
+        # compile-and-dispatch pipeline knobs (ops.precompile): background
+        # bucket pre-warm and the allocate action's dispatch/collect
+        # overlap (False = strictly serial solve for parity testing)
+        self.prewarmer = getattr(cache, "prewarmer", None)
+        self.pipeline_solver = getattr(cache, "pipeline_solver", True)
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)

@@ -227,6 +227,24 @@ queue_pod_group_running_count = registry.register(Gauge(
 queue_pod_group_unknown_count = registry.register(Gauge(
     "volcano_queue_pod_group_unknown_count", "Unknown PodGroup count", ["queue_name"]))
 
+# -- compile/dispatch pipeline metrics (ops.precompile) ---------------------
+
+solver_compile_total = registry.register(Counter(
+    "volcano_solver_compile_total",
+    "XLA backend compiles, by observing thread class", ["thread"]))
+solver_compile_seconds_total = registry.register(Counter(
+    "volcano_solver_compile_seconds_total",
+    "Seconds spent in XLA backend compiles, by thread class", ["thread"]))
+compile_cache_hits_total = registry.register(Counter(
+    "volcano_compile_cache_hits_total",
+    "Persistent compilation cache hits"))
+prewarm_completions_total = registry.register(Counter(
+    "volcano_prewarm_completions_total",
+    "Background bucket pre-warm completions"))
+session_phase_ms = registry.register(Gauge(
+    "volcano_session_phase_milliseconds",
+    "Per-phase latency of the last scheduling cycle", ["phase"]))
+
 # -- job / namespace metrics -----------------------------------------------
 
 job_share = registry.register(Gauge(

@@ -14,9 +14,14 @@ _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
          "solve_allocate_packed2d")
 _LAZY_EVICT = ("EvictResult", "solve_evict")
 _LAZY_DEVCACHE = ("PackedDeviceCache",)
+# precompile itself only imports jax lazily (inside functions/threads), but
+# routing it through the lazy hook keeps the import-cost contract uniform
+_LAZY_PRECOMPILE = ("BucketPrewarmer", "CompileWatcher",
+                    "configure_compilation_cache", "watcher")
 
 __all__ = ["FlattenCache", "ScoreParams", "SnapshotArrays", "bucket",
-           "flatten_snapshot", *_LAZY, *_LAZY_EVICT, *_LAZY_DEVCACHE]
+           "flatten_snapshot", *_LAZY, *_LAZY_EVICT, *_LAZY_DEVCACHE,
+           *_LAZY_PRECOMPILE]
 
 
 def __getattr__(name):
@@ -29,4 +34,7 @@ def __getattr__(name):
     if name in _LAZY_DEVCACHE:
         from . import device_cache
         return getattr(device_cache, name)
+    if name in _LAZY_PRECOMPILE:
+        from . import precompile
+        return getattr(precompile, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -58,9 +58,19 @@ class PackedDeviceCache:
         self.last_shipped_chunks = 0               # diagnostics
 
     def reset(self) -> None:
+        """Drop the mirror AND the device-resident state so the next
+        session re-ships everything. Called on any scatter/dispatch
+        failure here, and by the allocate action's collect path when an
+        async solve error surfaces at readback time — by then a donated
+        dispatch has already commit()ed buffers that no longer hold valid
+        data, so everything device-side (cached score params included: the
+        same fault that killed the solve may have killed their backing
+        buffers) must be treated as lost."""
         self._host_f = self._host_i = None
         self._dev_f = self._dev_i = None
         self._layout = None
+        self._params_blob = None
+        self._params_dev = None
 
     # -- shared mirror maintenance (update + plan_delta flows) ----------
 

@@ -50,6 +50,15 @@ def make_mesh(devices=None, axis: str = "n") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+#: static solve flags solve_allocate_sharded_packed2d accepts — a strict
+#: subset of the single-device entries' (no work_conserving/per_node_cap);
+#: the bucket prewarmer filters a session's flag set against this before
+#: warming the sharded variant (ops.precompile.BucketPrewarmer)
+PACKED2D_FLAGS = ("max_rounds", "max_gang_iters", "herd_mode",
+                  "score_families", "use_queue_cap", "use_drf_order",
+                  "use_hdrf_order", "fused")
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "max_rounds",
                                              "max_gang_iters", "herd_mode",
                                              "score_families",

@@ -29,7 +29,10 @@ class Scheduler:
                  scheduler_conf: Optional[str] = None,
                  conf_path: Optional[str] = None,
                  period: float = DEFAULT_SCHEDULE_PERIOD,
-                 percentage_of_nodes_to_find: int = 100):
+                 percentage_of_nodes_to_find: int = 100,
+                 compile_cache_dir: Optional[str] = None,
+                 prewarm: bool = False,
+                 pipeline_solver: bool = True):
         # adaptive host-loop node sampling knob, instance-scoped
         # (cmd/scheduler/app/options/options.go:37-40)
         from .utils import NodeSampler
@@ -43,6 +46,21 @@ class Scheduler:
         self.tiers = []
         self.configurations = []
         self.load_conf()
+        # compile-and-dispatch pipeline (ops.precompile): persistent
+        # on-disk XLA executable cache (explicit dir or
+        # $VOLCANO_COMPILE_CACHE_DIR), background next-bucket pre-warm,
+        # and the allocate action's dispatch/collect overlap. All three
+        # are pure-latency features — scheduling decisions are identical
+        # with them on or off (tests/test_precompile.py parity).
+        from .ops import precompile as _pc
+        self.compile_cache_dir = _pc.configure_compilation_cache(
+            compile_cache_dir)
+        cache.pipeline_solver = bool(pipeline_solver)
+        if prewarm and getattr(cache, "prewarmer", None) is None:
+            cache.prewarmer = _pc.BucketPrewarmer()
+        if prewarm or self.compile_cache_dir:
+            _pc.watcher.install()
+        self._compile_totals = _pc.watcher.session_totals()
 
     # -- conf hot reload (scheduler.go:112-170) -----------------------------
 
@@ -123,8 +141,37 @@ class Scheduler:
             timing["close_ms"] = (time.perf_counter() - tc) * 1e3
         total = (time.perf_counter() - t0) * 1e3
         timing["total_ms"] = total
+        self._export_pipeline_metrics(timing)
         self.last_cycle_timing = timing
         metrics.e2e_scheduling_latency.observe(total)
+
+    #: timing keys exported per cycle as the volcano_session_phase_ms
+    #: gauge — the flatten/upload/solve/replay decomposition the compile
+    #: pipeline work optimizes (upload = pack + delta_plan host share)
+    _PHASE_KEYS = ("open_ms", "flatten_ms", "pack_ms", "delta_plan_ms",
+                   "dispatch_ms", "overlap_ms", "readback_ms", "solve_ms",
+                   "replay_ms", "close_ms", "total_ms")
+
+    def _export_pipeline_metrics(self, timing: dict) -> None:
+        """Surface per-phase latency and the cycle's compile accounting in
+        both the metrics registry and last_cycle_timing: a full-solve XLA
+        compile landing on the session thread is THE tail-latency event
+        this scheduler exists to avoid, so it must be first-class
+        observable, not a mystery spike in total_ms."""
+        for key in self._PHASE_KEYS:
+            if key in timing:
+                metrics.session_phase_ms.set(
+                    timing[key], labels={"phase": key[:-3]})
+        from .ops.precompile import watcher
+        c, s = watcher.session_totals()
+        prev_c, prev_s = self._compile_totals
+        self._compile_totals = (c, s)
+        timing["session_compiles"] = float(c - prev_c)
+        timing["session_compile_s"] = s - prev_s
+        timing["compile_cache_hits"] = float(watcher.cache_hits)
+        pw = getattr(self.cache, "prewarmer", None)
+        if pw is not None:
+            timing["prewarm_completions"] = float(pw.completions)
 
     def run_with_leader_election(self, stop, lock_name: str = "volcano",
                                  identity: Optional[str] = None,
@@ -174,7 +221,17 @@ class Scheduler:
         renewer.join(timeout=2 * elector.retry_period)
 
     def run(self, stop_after: Optional[int] = None) -> None:
-        """Run the periodic loop; stop_after bounds cycles for tests."""
+        """Run the periodic loop; stop_after bounds cycles for tests.
+
+        The loop deliberately never blocks on the cache's async bind
+        effectors: with async_effectors on, cycle N's store writes drain
+        on the effector pool while cycle N+1 opens its session — the
+        snapshot clone and the effector-side accounting both run behind
+        the cache lock, so the overlap is race-free and the next snapshot
+        always sees a consistent mirror (the writes it may not yet see
+        are exactly the ones an informer-fed reference scheduler would
+        also still have in flight). Standalone.run mirrors this with
+        pipeline_effects=True."""
         self.cache.run()
         self.cache.wait_for_cache_sync()
         cycles = 0

@@ -43,7 +43,11 @@ class Standalone:
                  scheduler_name: str = "volcano",
                  default_queue: str = "default",
                  percentage_of_nodes_to_find: int = 100,
-                 leader_elect: bool = False):
+                 leader_elect: bool = False,
+                 compile_cache_dir: Optional[str] = None,
+                 prewarm: bool = False,
+                 pipeline_solver: bool = True,
+                 pipeline_effects: bool = False):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -131,18 +135,28 @@ class Standalone:
         self.controllers.run()
         self.scheduler = Scheduler(
             self.cache, scheduler_conf=scheduler_conf, period=period,
-            percentage_of_nodes_to_find=percentage_of_nodes_to_find)
+            percentage_of_nodes_to_find=percentage_of_nodes_to_find,
+            compile_cache_dir=compile_cache_dir, prewarm=prewarm,
+            pipeline_solver=pipeline_solver)
+        # pipeline_effects: don't drain the async bind effectors between
+        # control-plane turns — cycle N's API writes overlap cycle N+1's
+        # snapshot+flatten (see Scheduler.run). Off by default: embedding
+        # tests want each run_once() deterministic and fully applied.
+        self.pipeline_effects = pipeline_effects
         self.leader_elect = leader_elect
         self._elector = None
         self.metrics_server = MetricsServer(port=metrics_port).start()
         self._stop = threading.Event()
 
-    def run_once(self) -> None:
-        """One control-plane turn: controllers drain, scheduler cycles."""
+    def run_once(self, drain_effects: bool = True) -> None:
+        """One control-plane turn: controllers drain, scheduler cycles.
+        ``drain_effects=False`` (the run() loop under pipeline_effects)
+        leaves async binds in flight so they overlap the next turn."""
         self.controllers.process_all()
         self.scheduler.run_once()
         self.controllers.process_all()
-        self.cache.wait_for_effects()
+        if drain_effects:
+            self.cache.wait_for_effects()
 
     def run(self) -> None:
         if self.leader_elect:
@@ -163,7 +177,7 @@ class Standalone:
                 continue
             t0 = time.time()
             try:
-                self.run_once()
+                self.run_once(drain_effects=not self.pipeline_effects)
             except Exception:
                 log.exception("control-plane turn failed")
             delay = self.scheduler.period - (time.time() - t0)
@@ -172,6 +186,7 @@ class Standalone:
 
     def stop(self) -> None:
         self._stop.set()
+        self.cache.wait_for_effects()  # land in-flight pipelined binds
         self.metrics_server.stop()
         if self.store_server is not None:
             self.store_server.stop()
@@ -220,6 +235,21 @@ def main(argv=None) -> int:
     ap.add_argument("--leader-elect", action="store_true",
                     help="contend on the 'volcano' lease; only the "
                          "holder runs control-plane turns")
+    ap.add_argument("--compile-cache-dir", metavar="DIR",
+                    help="persistent XLA compilation cache directory "
+                         "(default $VOLCANO_COMPILE_CACHE_DIR): restarts "
+                         "and repeated bucket shapes skip recompiles")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="compile the next compile-bucket's solver "
+                         "variants on a background thread when occupancy "
+                         "nears the current bucket")
+    ap.add_argument("--serial-solver", action="store_true",
+                    help="disable the allocate dispatch/collect overlap "
+                         "(debug/parity; decisions are identical)")
+    ap.add_argument("--pipeline-effects", action="store_true",
+                    help="overlap async bind writes with the next "
+                         "control-plane turn instead of draining between "
+                         "turns")
     args = ap.parse_args(argv)
 
     conf = None
@@ -236,7 +266,11 @@ def main(argv=None) -> int:
                     scheduler_name=args.scheduler_name,
                     default_queue=args.default_queue,
                     percentage_of_nodes_to_find=args.percentage_nodes_to_find,
-                    leader_elect=args.leader_elect)
+                    leader_elect=args.leader_elect,
+                    compile_cache_dir=args.compile_cache_dir,
+                    prewarm=args.prewarm,
+                    pipeline_solver=not args.serial_solver,
+                    pipeline_effects=args.pipeline_effects)
     if args.jobs_dir:
         import glob
         import os
