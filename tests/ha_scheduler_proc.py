@@ -29,8 +29,11 @@ def main() -> int:
     from volcano_tpu.client import RemoteClusterStore
     from volcano_tpu.scheduler import Scheduler
 
-    # crash-only on a broken watch stream: the mirror can't resync in
-    # place, so exit and let the supervisor (or the HA standby) cover
+    # A broken watch stream first resumes in place (reconnect + journal
+    # replay from the rv high-water mark — a store-server restart is a
+    # logged blip, tests/test_resilience.py::TestCrossProcessWatchResume).
+    # Only when resume is impossible (window lost) does the crash-only
+    # fallback fire: exit and let the supervisor / HA standby cover.
     remote = RemoteClusterStore(
         args.server, on_watch_failure=lambda: os._exit(3))
     cache = SchedulerCache(remote)

@@ -169,7 +169,10 @@ class AllocateAction(Action):
         from ..ops import flatten_snapshot, solve_allocate, \
             solve_allocate_sequential
 
+        from ..resilience import faults
+
         timing = ssn.solver_options.setdefault("timing", {})
+        breaker = getattr(ssn, "breaker", None)
         t0 = _time.perf_counter()
         host_only = ssn.solver_options.get("host_only_jobs") or ()
         taskkey = _task_order_key(ssn)
@@ -276,82 +279,96 @@ class AllocateAction(Action):
 
         dc = getattr(ssn, "device_cache", None)
         sidecar = getattr(ssn, "sidecar", None)
-        if sequential:
-            res = solve_allocate_sequential(
-                arr.device_dict(), params, score_families=families,
-                use_queue_cap=use_queue_cap,
-                work_conserving=work_conserving)
-        elif sidecar is not None:
-            # process boundary: ship the packed snapshot to the solver
-            # sidecar (which owns the TPU) and replay its assignments
-            fbuf, ibuf, layout = arr.packed()
-            assigned, kind, _info = sidecar.solve(
-                fbuf, ibuf, layout, params, herd_mode=herd,
-                score_families=families, use_queue_cap=use_queue_cap,
-                use_drf_order=use_drf_order,
-                use_hdrf_order=use_hdrf_order,
-                work_conserving=work_conserving)
-            res = None
-        elif dc is not None:
-            # device-resident buffers, fused dispatch: the dirty-chunk
-            # scatter runs INSIDE the solve jit, so a session costs exactly
-            # one dispatch (scatter+solve) + one compact readback. Sessions
-            # dirtying more than FUSED_SLOTS chunks use the separate
-            # scatter + non-fused solve (3 dispatches, but no extra solve
-            # compile variants)
-            from ..ops.solver import (
-                solve_allocate_delta, solve_allocate_packed2d,
-            )
-            t1 = _time.perf_counter()
-            fbuf, ibuf, layout = arr.packed()
-            timing["pack_ms"] = (_time.perf_counter() - t1) * 1e3
-            params = dc.params_device(params)
-            # flags snapshot for diagnostics/benchmarks that re-dispatch
-            # the same solve variant against the committed buffers
-            dc.last_solve_flags = dict(
-                layout=layout, herd_mode=herd, score_families=families,
-                use_queue_cap=use_queue_cap, use_drf_order=use_drf_order,
-                use_hdrf_order=use_hdrf_order,
-                work_conserving=work_conserving)
-            dc.last_params = params
-            t1 = _time.perf_counter()
-            kind_, payload = dc.plan_delta(fbuf, ibuf, layout)
-            timing["delta_plan_ms"] = (_time.perf_counter() - t1) * 1e3
-            timing["delta_chunks"] = float(dc.last_shipped_chunks)
-            timing["delta_fused"] = float(kind_ == "fused")
-            t1 = _time.perf_counter()
-            if kind_ == "updated":
-                f2d, i2d = payload
-                res = solve_allocate_packed2d(
-                    f2d, i2d, layout, params, herd_mode=herd,
+        try:
+            # device-path circuit-breaker scope: anything that throws out
+            # of the dispatch (XLA runtime error, OOM, dead sidecar, an
+            # injected fault) counts one consecutive device failure and
+            # this session finishes through the host oracle
+            faults.fire("solver_dispatch")
+            if sequential:
+                res = solve_allocate_sequential(
+                    arr.device_dict(), params, score_families=families,
+                    use_queue_cap=use_queue_cap,
+                    work_conserving=work_conserving)
+            elif sidecar is not None:
+                # process boundary: ship the packed snapshot to the solver
+                # sidecar (which owns the TPU) and replay its assignments
+                fbuf, ibuf, layout = arr.packed()
+                assigned, kind, _info = sidecar.solve(
+                    fbuf, ibuf, layout, params, herd_mode=herd,
                     score_families=families, use_queue_cap=use_queue_cap,
                     use_drf_order=use_drf_order,
                     use_hdrf_order=use_hdrf_order,
                     work_conserving=work_conserving)
-            else:
-                f2d, i2d, fi, fv, ii, iv = payload
-                try:
-                    res, new_f, new_i = solve_allocate_delta(
-                        f2d, i2d, fi, fv, ii, iv, layout, params,
-                        herd_mode=herd, score_families=families,
+                res = None
+            elif dc is not None:
+                # device-resident buffers, fused dispatch: the dirty-chunk
+                # scatter runs INSIDE the solve jit, so a session costs
+                # exactly one dispatch (scatter+solve) + one compact
+                # readback. Sessions dirtying more than FUSED_SLOTS chunks
+                # use the separate scatter + non-fused solve (3
+                # dispatches, but no extra solve compile variants)
+                from ..ops.solver import (
+                    solve_allocate_delta, solve_allocate_packed2d,
+                )
+                t1 = _time.perf_counter()
+                fbuf, ibuf, layout = arr.packed()
+                timing["pack_ms"] = (_time.perf_counter() - t1) * 1e3
+                params = dc.params_device(params)
+                # flags snapshot for diagnostics/benchmarks that
+                # re-dispatch the same solve variant against the
+                # committed buffers
+                dc.last_solve_flags = dict(
+                    layout=layout, herd_mode=herd, score_families=families,
+                    use_queue_cap=use_queue_cap,
+                    use_drf_order=use_drf_order,
+                    use_hdrf_order=use_hdrf_order,
+                    work_conserving=work_conserving)
+                dc.last_params = params
+                t1 = _time.perf_counter()
+                kind_, payload = dc.plan_delta(fbuf, ibuf, layout)
+                timing["delta_plan_ms"] = (_time.perf_counter() - t1) * 1e3
+                timing["delta_chunks"] = float(dc.last_shipped_chunks)
+                timing["delta_fused"] = float(kind_ == "fused")
+                t1 = _time.perf_counter()
+                if kind_ == "updated":
+                    f2d, i2d = payload
+                    res = solve_allocate_packed2d(
+                        f2d, i2d, layout, params, herd_mode=herd,
+                        score_families=families,
                         use_queue_cap=use_queue_cap,
                         use_drf_order=use_drf_order,
                         use_hdrf_order=use_hdrf_order,
                         work_conserving=work_conserving)
-                except Exception:
-                    # donation may have consumed the buffers: drop the
-                    # mirror so the next session re-ships in full
-                    dc.reset()
-                    raise
-                dc.commit(new_f, new_i)
-            timing["dispatch_ms"] = (_time.perf_counter() - t1) * 1e3
-        else:
-            res = solve_allocate(
-                arr.device_dict(), params, herd_mode=herd,
-                score_families=families, use_queue_cap=use_queue_cap,
-                use_drf_order=use_drf_order,
-                use_hdrf_order=use_hdrf_order,
-                work_conserving=work_conserving)
+                else:
+                    f2d, i2d, fi, fv, ii, iv = payload
+                    try:
+                        res, new_f, new_i = solve_allocate_delta(
+                            f2d, i2d, fi, fv, ii, iv, layout, params,
+                            herd_mode=herd, score_families=families,
+                            use_queue_cap=use_queue_cap,
+                            use_drf_order=use_drf_order,
+                            use_hdrf_order=use_hdrf_order,
+                            work_conserving=work_conserving)
+                    except Exception:
+                        # donation may have consumed the buffers: drop the
+                        # mirror so the next session re-ships in full
+                        dc.reset()
+                        raise
+                    dc.commit(new_f, new_i)
+                timing["dispatch_ms"] = (_time.perf_counter() - t1) * 1e3
+            else:
+                res = solve_allocate(
+                    arr.device_dict(), params, herd_mode=herd,
+                    score_families=families, use_queue_cap=use_queue_cap,
+                    use_drf_order=use_drf_order,
+                    use_hdrf_order=use_hdrf_order,
+                    work_conserving=work_conserving)
+        except Exception:
+            log.exception("solver dispatch failed; resetting the device "
+                          "cache and falling back to the host loop")
+            self._device_fault_fallback(ssn, dc, timing, breaker)
+            return
         # ------------------------------------------------------------------
         # dispatch/collect split: the jitted solve above is an ASYNC
         # dispatch (res holds device futures), so the host is free until
@@ -397,6 +414,9 @@ class AllocateAction(Action):
                 else:  # >16k nodes: node index overflows int16 packing
                     assigned = np.asarray(res.assigned)
                     kind = np.asarray(res.kind)
+                self._check_solver_output(assigned, kind,
+                                          len(tasks_in_order),
+                                          len(arr.nodes_list))
             except Exception:
                 # async-collect failure: the error surfaces HERE, after a
                 # donated-buffer dispatch already commit()ed what are now
@@ -407,11 +427,7 @@ class AllocateAction(Action):
                 # one slow cycle, not a scheduling gap
                 log.exception("solver collect failed; resetting device "
                               "cache and falling back to the host loop")
-                if dc is not None:
-                    dc.reset()
-                timing["host_fallback"] = 1.0
-                ssn.solver_options["_post_host_jobs"] = []
-                self._execute_host(ssn)
+                self._device_fault_fallback(ssn, dc, timing, breaker)
                 return
             timing["readback_ms"] = (_time.perf_counter() - t1) * 1e3
             if not pipelined:
@@ -419,6 +435,22 @@ class AllocateAction(Action):
                 # turning the overlap off doesn't also disable the
                 # compile-stall protection
                 self._observe_prewarm(ssn, arr, dc)
+        else:
+            # sidecar path: assignments are already host arrays
+            try:
+                self._check_solver_output(np.asarray(assigned),
+                                          np.asarray(kind),
+                                          len(tasks_in_order),
+                                          len(arr.nodes_list))
+            except Exception:
+                log.exception("sidecar solver output failed validation; "
+                              "falling back to the host loop")
+                self._device_fault_fallback(ssn, dc, timing, breaker)
+                return
+        if breaker is not None:
+            # a full dispatch+collect round-trip with sane output: the
+            # device path is healthy (closes a half-open breaker)
+            breaker.record_success()
         timing["solve_ms"] = (_time.perf_counter() - t0) * 1e3
         t0 = _time.perf_counter()
 
@@ -445,6 +477,38 @@ class AllocateAction(Action):
             # replay blows up (per-statement commits applied them eagerly)
             flush_bulk_commit(ssn, acc)
         timing["replay_ms"] = (_time.perf_counter() - t0) * 1e3
+
+    def _device_fault_fallback(self, ssn, dc, timing, breaker) -> None:
+        """Shared device-failure containment: count the failure against
+        the circuit breaker, drop the (possibly poisoned) device-resident
+        buffers, and finish THIS session through the host oracle — a
+        device fault costs one slow cycle, never a scheduling gap
+        (degradation ladder: device -> host oracle -> skip cycle)."""
+        if breaker is not None:
+            breaker.record_failure()
+        if dc is not None:
+            dc.reset()
+        timing["host_fallback"] = 1.0
+        ssn.solver_options["_post_host_jobs"] = []
+        self._execute_host(ssn)
+
+    @staticmethod
+    def _check_solver_output(assigned, kind, n_tasks: int,
+                             n_nodes: int) -> None:
+        """Reject garbage readbacks (a sick device can return buffers
+        full of nonsense without raising): node indices must be in
+        [-1, n_nodes) and the pipeline flag boolean for every real task.
+        Raising here routes through the same collect-failure fallback as
+        an exception from the device itself."""
+        a = np.asarray(assigned)[:n_tasks]
+        k = np.asarray(kind)[:n_tasks]
+        if not np.isfinite(a.astype(np.float64)).all():
+            raise RuntimeError("solver returned non-finite assignments")
+        if a.size and (((a < -1) | (a >= n_nodes)).any()
+                       or ((a >= 0) & (k != 0) & (k != 1)).any()):
+            raise RuntimeError(
+                "solver output failed sanity checks (node index out of "
+                f"[-1, {n_nodes}) or non-boolean pipeline flag)")
 
     @staticmethod
     def _observe_prewarm(ssn, arr, dc) -> None:
@@ -673,6 +737,16 @@ class AllocateAction(Action):
 
     def execute(self, ssn) -> None:
         mode = self.resolve_mode(ssn)
+        breaker = getattr(ssn, "breaker", None)
+        if mode != "host" and breaker is not None and not breaker.allow():
+            # device path circuit-broken: go straight to the host oracle
+            # for this cycle instead of paying a doomed dispatch (the
+            # cool-down's half-open probe re-tries the device path later)
+            timing = ssn.solver_options.setdefault("timing", {})
+            timing["host_fallback"] = 1.0
+            timing["breaker_open"] = 1.0
+            breaker.count_fallback()
+            mode = "host"
         if mode == "host":
             self._execute_host(ssn)
             return

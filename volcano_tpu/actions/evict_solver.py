@@ -182,12 +182,19 @@ def _uniform_job_arrays(arr, job_order):
 def run_evict_solver(ssn, mode: str, skip_jobs=()):
     """Flatten claimers + victims, solve on device, replay. Returns the
     claimer jobs processed (the host loops' under_request set — preempt's
-    intra-job phase must run on exactly these), or [] when there was
-    nothing to do."""
+    intra-job phase must run on exactly these), [] when there was nothing
+    to do, or None when the device path is unavailable (circuit breaker
+    open, or the solve itself failed) — the caller then degrades to its
+    host loop for this cycle."""
     from ..ops import flatten_snapshot
     from ..ops.evict import solve_evict
+    from ..resilience import faults
     from .allocate import build_score_inputs
 
+    breaker = getattr(ssn, "breaker", None)
+    if breaker is not None and not breaker.allow():
+        breaker.count_fallback()
+        return None  # circuit open: host loop covers this cycle
     preempt = mode == "preempt"
     job_order = collect_claimer_jobs(
         ssn, require_not_pipelined=preempt, skip_overused=not preempt,
@@ -214,33 +221,49 @@ def run_evict_solver(ssn, mode: str, skip_jobs=()):
          varrays["job_count"]) = uniform
     vnp = {k: np.asarray(v) for k, v in varrays.items()}
     sidecar = getattr(ssn, "sidecar", None)
-    if sidecar is not None:
-        # process boundary: evict solves ship to the solver process too
-        # (presence of job_req in the victim dict selects the fast path)
-        assigned, evicted_by = sidecar.solve_evict(
-            arr.device_dict(), vnp, params, score_families=families,
-            require_freed_covers=not preempt,
-            allow_revert=preempt, stop_at_need=preempt)
-    else:
-        if uniform is not None:
-            # gang fast path: one solve step per JOB (solve_evict_uniform)
-            from ..ops.evict import solve_evict_uniform
-            res = solve_evict_uniform(
-                arr.device_dict(), vnp, params, score_families=families,
-                require_freed_covers=False, stop_at_need=True)
-        else:
-            res = solve_evict(
+    try:
+        # breaker scope: a throwing evict dispatch/collect (or an injected
+        # fault) counts one consecutive device failure; the caller's host
+        # loop covers this cycle
+        faults.fire("evict_dispatch")
+        if sidecar is not None:
+            # process boundary: evict solves ship to the solver process
+            # too (job_req in the victim dict selects the fast path)
+            assigned, evicted_by = sidecar.solve_evict(
                 arr.device_dict(), vnp, params, score_families=families,
                 require_freed_covers=not preempt,
                 allow_revert=preempt, stop_at_need=preempt)
-        from ..ops.evict import decode_evict_compact
-        try:
-            # one int16 readback carries both outputs (remote-chip wire)
-            assigned, evicted_by = decode_evict_compact(
-                res.compact, arr.task_init_req.shape[0])
-        except ValueError:  # >32k nodes/jobs: indices overflow the packing
-            assigned = np.asarray(res.assigned)
-            evicted_by = np.asarray(res.evicted_by)
+        else:
+            if uniform is not None:
+                # gang fast path: one solve step per JOB
+                # (solve_evict_uniform)
+                from ..ops.evict import solve_evict_uniform
+                res = solve_evict_uniform(
+                    arr.device_dict(), vnp, params,
+                    score_families=families,
+                    require_freed_covers=False, stop_at_need=True)
+            else:
+                res = solve_evict(
+                    arr.device_dict(), vnp, params,
+                    score_families=families,
+                    require_freed_covers=not preempt,
+                    allow_revert=preempt, stop_at_need=preempt)
+            from ..ops.evict import decode_evict_compact
+            try:
+                # one int16 readback carries both outputs (remote wire)
+                assigned, evicted_by = decode_evict_compact(
+                    res.compact, arr.task_init_req.shape[0])
+            except ValueError:  # >32k nodes/jobs: indices overflow packing
+                assigned = np.asarray(res.assigned)
+                evicted_by = np.asarray(res.evicted_by)
+    except Exception:
+        log.exception("%s device solve failed; degrading to the host "
+                      "loop for this cycle", mode)
+        if breaker is not None:
+            breaker.record_failure()
+        return None
+    if breaker is not None:
+        breaker.record_success()
     by_job = _evictions_by_job(evicted_by)
 
     from ..metrics import metrics

@@ -79,6 +79,11 @@ class PreemptAction(Action):
         host_only = set(ssn.solver_options.get("host_only_jobs") or ())
         from .evict_solver import run_evict_solver
         claimers = run_evict_solver(ssn, "preempt", skip_jobs=host_only)
+        if claimers is None:
+            # device path unavailable (breaker open / solve failed):
+            # degrade the whole action to the host loop for this cycle
+            self._execute_host(ssn)
+            return
         if host_only:
             self._execute_host(ssn, only_jobs=host_only)
         # intra-job task-level preemption stays on the host path (small,

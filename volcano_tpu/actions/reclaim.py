@@ -30,7 +30,11 @@ class ReclaimAction(Action):
         # per-job routing (mirrors allocate, ADVICE r2 #3)
         host_only = set(ssn.solver_options.get("host_only_jobs") or ())
         from .evict_solver import run_evict_solver
-        run_evict_solver(ssn, "reclaim", skip_jobs=host_only)
+        if run_evict_solver(ssn, "reclaim", skip_jobs=host_only) is None:
+            # device path unavailable (breaker open / solve failed):
+            # degrade the whole action to the host loop for this cycle
+            self._execute_host(ssn)
+            return
         if host_only:
             self._execute_host(ssn, only_jobs=host_only)
 

@@ -299,6 +299,10 @@ class SchedulerCache:
         # gates the allocate action's dispatch/collect overlap
         self.prewarmer = None
         self.pipeline_solver = True
+        # device-path circuit breaker (resilience.CircuitBreaker): the
+        # Scheduler installs one; sessions read it for the device -> host
+        # oracle degradation ladder in allocate/preempt/reclaim
+        self.breaker = None
 
         # job uid -> flat_version reflected by the last successful status
         # write; the job updater's skip-if-untouched check compares against
@@ -346,7 +350,15 @@ class SchedulerCache:
 
     def _on_pod(self, event, obj, old):
         if event == "add":
-            self.add_pod(obj)
+            # resync-safe: a watch-resume (or re-list) can replay an add
+            # for a pod this mirror already tracks; treating it as an
+            # update keeps the node/job accounting single-counted instead
+            # of raising out of the delivery (informer AddFunc semantics
+            # on a re-listed object)
+            if self._stored_task(TaskInfo(obj)) is not None:
+                self.update_pod(obj, obj)
+            else:
+                self.add_pod(obj)
         elif event == "update":
             self.update_pod(old, obj)
         else:

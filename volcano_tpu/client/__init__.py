@@ -4,4 +4,5 @@ from .remote import RemoteClusterStore  # noqa: F401
 from .server import StoreServer  # noqa: F401
 from .store import (  # noqa: F401
     AdmissionError, ClusterStore, ConflictError, NotFoundError,
+    ResumeGapError,
 )

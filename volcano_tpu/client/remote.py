@@ -22,37 +22,53 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..resilience.faultinject import faults
 from .codec import decode, encode
 from .server import MAGIC, raise_remote, recv_frame, send_frame
+from .store import ResumeGapError
 
 log = logging.getLogger(__name__)
 
 
 class RemoteClusterStore:
-    """See module docstring. Two deployment-facing knobs:
+    """See module docstring. Deployment-facing knobs:
 
     - ``token``: shared-secret auth presented on every connection
       (defaults to $VOLCANO_STORE_TOKEN so vcctl and operator scripts
       pick it up without plumbing).
-    - ``on_watch_failure``: called once when a watch stream dies. The
-      cache's event handlers are NOT idempotent (replaying adds would
-      double-count), so a broken stream cannot be transparently resumed;
-      the crash-only answer is to exit and let the supervisor restart
-      with a fresh snapshot (HA standbys cover the gap — client-go's
-      reflector re-list is this build's process restart). The default
-      logs CRITICAL and sets ``watch_failed``; long-running consumers
-      (ha_scheduler_proc) pass an exiting callback."""
+    - ``on_watch_failure``: called once when a watch stream dies beyond
+      repair. A broken stream first tries to RESUME in place: reconnect
+      with exponential backoff + jitter and ask the server to replay from
+      this client's per-kind resource_version high-water mark (the
+      server's EventJournal — client-go's reflector re-watch). Only when
+      that fails — server gone past ``watch_resume_window_s``, journal
+      window lost (ResumeGapError), or a listener itself blew up — does
+      the crash-only contract fire: log CRITICAL, set ``watch_failed``,
+      call the callback once so a supervisor can restart with a fresh
+      snapshot (HA standbys cover the gap).
+    - ``retry_attempts``/``retry_base_s``/``retry_cap_s``: idempotent-op
+      retry budget (see _request) — defaults ride out a ~3 s server
+      restart.
+    """
 
     def __init__(self, address: str, connect_timeout: float = 5.0,
                  token: Optional[str] = None,
                  on_watch_failure: Optional[Callable[[], None]] = None,
                  tls_ca: Optional[str] = None,
                  tls_cert: Optional[str] = None,
-                 tls_key: Optional[str] = None):
+                 tls_key: Optional[str] = None,
+                 retry_attempts: int = 5,
+                 retry_base_s: float = 0.1,
+                 retry_cap_s: float = 2.0,
+                 watch_resume: bool = True,
+                 watch_resume_window_s: float = 30.0,
+                 watch_backoff_cap_s: float = 2.0):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -72,25 +88,39 @@ class RemoteClusterStore:
             import ssl
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-            ctx.check_hostname = False  # cluster-internal addr, CA-pinned
             ctx.verify_mode = ssl.CERT_REQUIRED
             if self.tls_ca:
+                # CA-pinned: the operator named the exact CA this server
+                # must chain to, and cluster-internal addresses are
+                # usually bare IPs — hostname matching adds nothing the
+                # pin doesn't already guarantee
+                ctx.check_hostname = False
                 ctx.load_verify_locations(self.tls_ca)
             else:
-                # client-cert-only config: verify the server against the
-                # system trust store instead of an empty one
+                # client-cert-only config: falls back to the SYSTEM trust
+                # store, where hostname verification is the only thing
+                # stopping any public-CA cert for any host from
+                # impersonating the store — keep it on (default True)
                 ctx.load_default_certs()
             if self.tls_cert:
                 ctx.load_cert_chain(self.tls_cert, self.tls_key)
             self._ssl_ctx = ctx
         self.on_watch_failure = on_watch_failure
         self.watch_failed = False
+        self.retry_attempts = retry_attempts
+        self.retry_base_s = retry_base_s
+        self.retry_cap_s = retry_cap_s
+        self.watch_resume = watch_resume
+        self.watch_resume_window_s = watch_resume_window_s
+        self.watch_backoff_cap_s = watch_backoff_cap_s
+        self.watch_resumes = 0   # successful in-place stream resumes
         self._lock = threading.RLock()   # local mirror/listener lock
         self._conn_lock = threading.Lock()  # serializes request/response
         self._conn: Optional[socket.socket] = None
         self._watch_threads: List[threading.Thread] = []
         self._watch_socks: List[socket.socket] = []
         self._closed = False
+        self._stop_event = threading.Event()  # wakes backoff sleeps
 
     # -- plumbing -----------------------------------------------------------
 
@@ -116,32 +146,51 @@ class RemoteClusterStore:
         # complete a partial one). A failure AFTER the send is ambiguous —
         # the server may have applied the op — so only idempotent reads
         # retry there; a mutating op surfaces the error to its caller
-        # rather than risk double-apply.
+        # rather than risk double-apply. Retries back off exponentially
+        # with jitter (base -> cap), so a briefly-restarting server (a
+        # 2-second systemd bounce) is ridden out instead of failing the
+        # first read — and a thundering herd of reconnecting clients
+        # spreads instead of synchronizing.
         idempotent = payload.get("op") in ("get", "list", "ping")
+        delay = self.retry_base_s
+        attempt = 0
         with self._conn_lock:
-            for attempt in (0, 1):
-                if self._conn is None:
-                    self._conn = self._connect()
+            while True:
                 sent = False
                 try:
+                    faults.fire("store_request")
+                    if self._conn is None:
+                        self._conn = self._connect()
                     send_frame(self._conn, payload)
                     sent = True
                     resp = recv_frame(self._conn)
                     break
                 except (ConnectionError, OSError):
-                    try:
-                        self._conn.close()
-                    except OSError:
-                        pass
-                    self._conn = None
-                    if attempt or (sent and not idempotent):
+                    if self._conn is not None:
+                        try:
+                            self._conn.close()
+                        except OSError:
+                            pass
+                        self._conn = None
+                    attempt += 1
+                    if (sent and not idempotent) \
+                            or attempt > self.retry_attempts \
+                            or self._closed:
                         raise
+                    try:
+                        from ..metrics import metrics
+                        metrics.store_request_retries_total.inc()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._stop_event.wait(delay * (0.5 + random.random()))
+                    delay = min(delay * 2.0, self.retry_cap_s)
         if not resp.get("ok"):
             raise_remote(resp)
         return resp
 
     def close(self) -> None:
         self._closed = True
+        self._stop_event.set()  # wake any backoff sleep immediately
         with self._conn_lock:
             if self._conn is not None:
                 try:
@@ -217,71 +266,158 @@ class RemoteClusterStore:
         """Subscribe over a dedicated streaming connection. The replay is
         applied inline before returning (list-then-watch, same synchronous
         contract as the in-memory store); live events are then delivered
-        from a daemon reader thread under self.locked()."""
+        from a daemon reader thread under self.locked(). A broken stream
+        resumes in place when it can (see class docstring)."""
         sock = self._connect()
         # register BEFORE the replay loop: close() must be able to unblock
         # a watch() stuck mid-replay on a stalled server
         self._watch_socks.append(sock)
         send_frame(sock, {"op": "watch", "kinds": [kind], "replay": replay})
-        while True:
-            msg = recv_frame(sock)
-            if msg.get("ok") is False:
-                # server refused the subscription (e.g. unknown kind):
-                # surface its message, not a dangling ConnectionError
-                try:
-                    self._watch_socks.remove(sock)
-                except ValueError:
-                    pass
-                sock.close()
-                raise_remote(msg)
-            stream = msg.get("stream")
-            if stream == "synced":
-                break
-            if stream == "event":
-                # under self._lock like the reader threads: during the
-                # cache's sequential subscriptions (nodes, then pods, ...)
-                # a LIVE event on an earlier kind's stream must not mutate
-                # the mirror concurrently with a later kind's replay —
-                # cache handlers rely on the store serializing dispatch
-                with self._lock:
-                    self._deliver(listener, msg)
+        state = {"hwm": -1}  # per-kind resume high-water mark
+        try:
+            self._apply_stream(sock, kind, listener, state,
+                               until_synced=True)
+        except Exception:
+            # server refused the subscription (e.g. unknown kind) or died
+            # mid-replay: surface it to the caller, nothing to resume yet
+            self._drop_watch_sock(sock)
+            raise
 
         def reader():
-            try:
-                while True:
-                    msg = recv_frame(sock)
-                    if msg.get("stream") != "event":
-                        continue  # heartbeat
-                    with self._lock:
-                        self._deliver(listener, msg)
-            except (ConnectionError, OSError, ValueError) as e:
-                if not self._closed:
-                    self._watch_broke(kind, e)
-            except Exception as e:  # noqa: BLE001 — a listener blew up
-                log.exception("watch listener for %s failed", kind)
-                if not self._closed:
-                    self._watch_broke(kind, e)
-            finally:
+            cur = sock
+            while True:
                 try:
-                    sock.close()
-                except OSError:
-                    pass
+                    self._apply_stream(cur, kind, listener, state,
+                                       until_synced=False)
+                except (ConnectionError, OSError, ValueError) as e:
+                    self._drop_watch_sock(cur)
+                    if self._closed:
+                        return
+                    cur = self._resume_watch(kind, listener, state)
+                    if cur is None:
+                        self._watch_broke(kind, e)
+                        return
+                    continue
+                except Exception as e:  # noqa: BLE001 — a listener blew up
+                    # mid-handler: the mirror itself may be inconsistent,
+                    # which no stream resume can repair — crash-only
+                    log.exception("watch listener for %s failed", kind)
+                    self._drop_watch_sock(cur)
+                    if not self._closed:
+                        self._watch_broke(kind, e)
+                    return
 
         t = threading.Thread(target=reader, daemon=True,
                              name=f"store-watch-{kind}")
         t.start()
         self._watch_threads.append(t)
 
+    def _apply_stream(self, sock, kind: str, listener, state: dict,
+                      until_synced: bool) -> None:
+        """Read frames from a watch socket, delivering events under the
+        mirror lock and advancing the resume high-water mark atomically
+        with each delivery (so a resume never skips or repeats an event).
+        Returns at the 'synced' marker when ``until_synced``, else loops
+        until the connection dies."""
+        while True:
+            msg = recv_frame(sock)
+            faults.fire("watch_stream")
+            if msg.get("ok") is False:
+                raise_remote(msg)
+            stream = msg.get("stream")
+            if stream == "synced":
+                rv = (msg.get("rv") or {}).get(kind)
+                if rv is not None:
+                    with self._lock:
+                        state["hwm"] = max(state["hwm"], int(rv))
+                if until_synced:
+                    return
+                continue
+            if stream != "event":
+                continue  # heartbeat
+            # under self._lock like every delivery: during the cache's
+            # sequential subscriptions (nodes, then pods, ...) a LIVE
+            # event on an earlier kind's stream must not mutate the
+            # mirror concurrently with a later kind's replay — cache
+            # handlers rely on the store serializing dispatch
+            with self._lock:
+                self._deliver(listener, msg)
+                rv = msg.get("rv")
+                if rv is not None:
+                    state["hwm"] = max(state["hwm"], int(rv))
+
+    def _resume_watch(self, kind: str, listener, state: dict):
+        """Reconnect a broken watch stream with exponential backoff +
+        jitter and ask the server to replay from our high-water mark.
+        Returns the new streaming socket (mirror already resynced), or
+        None when resume is impossible — unknown high-water mark, resume
+        window lost server-side (ResumeGapError), or the server stayed
+        unreachable past ``watch_resume_window_s`` — in which case the
+        caller falls back to the crash-only contract."""
+        hwm = state["hwm"]
+        if not self.watch_resume or hwm < 0:
+            return None
+        deadline = time.monotonic() + self.watch_resume_window_s
+        delay = 0.05
+        attempt = 0
+        while not self._closed:
+            attempt += 1
+            sock = None
+            try:
+                sock = self._connect()
+                self._watch_socks.append(sock)
+                send_frame(sock, {"op": "watch", "kinds": [kind],
+                                  "replay": False,
+                                  "since": {kind: state["hwm"]}})
+                # the missed-event replay lands here, inline
+                self._apply_stream(sock, kind, listener, state,
+                                   until_synced=True)
+            except ResumeGapError as e:
+                self._drop_watch_sock(sock)
+                log.error("watch stream for %r cannot resume: %s", kind, e)
+                return None
+            except (ConnectionError, OSError, ValueError):
+                self._drop_watch_sock(sock)
+                if time.monotonic() >= deadline:
+                    return None
+                self._stop_event.wait(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, self.watch_backoff_cap_s)
+                continue
+            with self._lock:
+                self.watch_resumes += 1
+            try:
+                from ..metrics import metrics
+                metrics.watch_reconnects_total.inc(labels={"kind": kind})
+            except Exception:  # noqa: BLE001
+                pass
+            log.warning("watch stream for %r resumed from rv %s "
+                        "(attempt %d)", kind, hwm, attempt)
+            return sock
+        return None
+
+    def _drop_watch_sock(self, sock) -> None:
+        if sock is None:
+            return
+        try:
+            self._watch_socks.remove(sock)
+        except ValueError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
     def _watch_broke(self, kind: str, exc: Exception) -> None:
-        """A watch stream died: the local mirror is permanently stale
-        (see class docstring for why there is no transparent resume)."""
+        """A watch stream died beyond repair: the local mirror is
+        permanently stale (resume was either disabled, out of window, or
+        the listener itself corrupted mid-delivery)."""
         with self._lock:  # streams die together when the server goes:
             first = not self.watch_failed  # fire the callback exactly once
             self.watch_failed = True
         log.critical(
-            "watch stream for %r broke (%s: %s); this store's mirror is "
-            "frozen — restart the consumer process to resync",
-            kind, type(exc).__name__, exc)
+            "watch stream for %r broke (%s: %s) and could not resume; "
+            "this store's mirror is frozen — restart the consumer "
+            "process to resync", kind, type(exc).__name__, exc)
         if first and self.on_watch_failure is not None:
             try:
                 self.on_watch_failure()

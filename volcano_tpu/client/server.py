@@ -13,13 +13,20 @@ Protocol: 4-byte magic "VCS1", then frames of <u32 length><JSON bytes>.
 Request ops mirror the ClusterStore surface; errors return their class
 name and re-raise as the same class client-side. A `watch` request turns
 the connection into an event stream: replayed adds, then {"stream":
-"synced"}, then live events as they commit. Frame size is capped so a
-corrupt or hostile peer cannot drive unbounded allocation (same rule as
-the solver sidecar, parallel/sidecar.py:35-53).
+"synced", "rv": {...}}, then live events (each carrying the global
+resource_version it committed at) as they commit. A watch request with
+"since": {kind: rv} instead resumes from that high-water mark: the
+per-kind EventJournal replays exactly the missed events (client-go's
+reflector re-watch at a ResourceVersion), or refuses with ResumeGapError
+when its bounded window no longer covers them — the client then falls
+back to its crash-only path. Frame size is capped so a corrupt or
+hostile peer cannot drive unbounded allocation (same rule as the solver
+sidecar, parallel/sidecar.py:35-53).
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import queue
@@ -27,13 +34,14 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 import hmac
 
 from .codec import decode, encode
 from .store import (
     KINDS, AdmissionError, ClusterStore, ConflictError, NotFoundError,
+    ResumeGapError,
 )
 
 log = logging.getLogger(__name__)
@@ -43,12 +51,72 @@ MAX_FRAME_BYTES = 64 << 20  # a 10k-pod wave of Jobs is ~10 MB of JSON
 WATCH_QUEUE_MAX = 65536     # pending events before a slow watcher drops
 WATCH_SEND_TIMEOUT_S = 30.0
 TLS_HANDSHAKE_TIMEOUT_S = 10.0
+JOURNAL_CAPACITY = 4096     # per-kind resume window (events)
 
 _ERRORS = {
     "ConflictError": ConflictError,
     "NotFoundError": NotFoundError,
     "AdmissionError": AdmissionError,
+    "ResumeGapError": ResumeGapError,
 }
+
+
+class EventJournal:
+    """Per-kind ring of recent committed events keyed by the store's
+    global resource_version, so a reconnecting watcher resumes from its
+    high-water mark instead of tearing its mirror down. Bounded: once a
+    kind's ring has dropped an event (or the event predates this
+    journal), resumes from before that point refuse (ResumeGapError).
+
+    Entries hold the live store objects and encode lazily at resume time
+    — the common case (no broken watchers) pays one deque append per
+    write, no JSON. With the store's in-place-update idiom a replayed
+    event can therefore carry a slightly newer object state than it
+    committed with; the mirror still converges (level-triggered, and the
+    cache's handlers are resync-safe)."""
+
+    def __init__(self, store: ClusterStore, capacity: int = JOURNAL_CAPACITY):
+        self.store = store
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: Dict[str, collections.deque] = {}
+        #: per kind: events at or below this rv are NOT replayable
+        self._floor: Dict[str, int] = {}
+        self._listeners = []
+        with store.locked():
+            for kind in KINDS:
+                self._events[kind] = collections.deque()
+                self._floor[kind] = store.last_event_rv(kind)
+                listener = self._make_listener(kind)
+                self._listeners.append((kind, listener))
+                store.watch(kind, listener, replay=False)
+
+    def _make_listener(self, kind: str):
+        def listener(event, obj, old):
+            # runs under the store lock: _rv is the rv this event
+            # committed at (store._notify stamps _kind_rv from it too)
+            rv = self.store._rv
+            with self._lock:
+                q = self._events[kind]
+                if len(q) >= self.capacity:
+                    self._floor[kind] = q.popleft()[0]
+                q.append((rv, event, obj, old))
+        return listener
+
+    def since(self, kind: str, rv: int):
+        """[(rv, event, obj, old)] committed after ``rv``, or None when
+        the window no longer covers that point."""
+        with self._lock:
+            if rv < self._floor[kind]:
+                return None
+            return [e for e in self._events[kind] if e[0] > rv]
+
+    def close(self) -> None:
+        """Unsubscribe (a stopped server must not keep journaling into a
+        store that outlives it — the restart case builds a fresh one)."""
+        for kind, listener in self._listeners:
+            self.store.unwatch(kind, listener)
+        self._listeners = []
 
 
 def send_frame(sock: socket.socket, payload: dict) -> None:
@@ -190,45 +258,74 @@ class _Handler(socketserver.BaseRequestHandler):
                               "message": f"unknown watch kinds {bad}"})
             return
         replay = bool(req.get("replay", True))
+        since = req.get("since") or None  # {kind: rv} = resume request
+        journal: Optional[EventJournal] = getattr(self.server, "journal",
+                                                  None)
         # bounded queue + send timeout: a peer that stalls without closing
         # (TCP zero window) otherwise blocks the writer in sendall forever
         # while the listeners keep enqueueing — unbounded memory per stuck
         # watcher. On overflow the watcher is dropped (client-go's watch
         # buffers terminate slow watchers the same way); the client sees
-        # the close and treats it as a broken stream (crash-only resync).
+        # the close and treats it as a broken stream (resume-then-resync).
         events: "queue.Queue" = queue.Queue(maxsize=WATCH_QUEUE_MAX)
         overflowed = threading.Event()
         sock.settimeout(WATCH_SEND_TIMEOUT_S)
 
+        def enqueue(payload) -> None:
+            if overflowed.is_set():
+                return  # watcher already condemned: stop buffering
+            try:
+                events.put_nowait(payload)
+            except queue.Full:
+                overflowed.set()
+
         def listener_for(kind):
             def listener(event, obj, old):
-                if overflowed.is_set():
-                    return  # watcher already condemned: stop buffering
-                try:
-                    events.put_nowait(
-                        {"stream": "event", "kind": kind,
-                         "event": event, "obj": encode(obj),
+                # under the store lock: store._rv is this event's rv
+                enqueue({"stream": "event", "kind": kind,
+                         "rv": store._rv, "event": event,
+                         "obj": encode(obj),
                          "old": encode(old) if old is not None else None})
-                except queue.Full:
-                    overflowed.set()
             return listener
 
         listeners = []
         try:
-            # subscribe with replay: the replayed adds land in the queue
-            # before any post-subscribe event (watch() delivers under the
-            # store lock), preserving list-then-watch ordering
-            for kind in kinds:
-                listener = listener_for(kind)
-                listeners.append((kind, listener))
-                store.watch(kind, listener, replay=replay)
-            try:
-                # put_nowait like the listeners: a replay bigger than the
-                # whole queue has already condemned this watcher, and a
-                # blocking put would deadlock (nothing drains yet)
-                events.put_nowait({"stream": "synced"})
-            except queue.Full:
-                overflowed.set()
+            # subscribe (and, on resume, read the journal) under ONE hold
+            # of the store lock: no event can fall between the replayed
+            # window and the live stream, and the synced rv map is exact.
+            # put_nowait throughout: a replay bigger than the whole queue
+            # has already condemned this watcher, and a blocking put would
+            # deadlock (nothing drains yet).
+            gap_kind = None
+            with store.locked():
+                if since is not None:
+                    for kind in kinds:
+                        missed = journal.since(kind, int(since.get(kind, -1))) \
+                            if journal is not None else None
+                        if missed is None:
+                            gap_kind = kind
+                            break
+                        for rv, event, obj, old in missed:
+                            enqueue({"stream": "event", "kind": kind,
+                                     "rv": rv, "event": event,
+                                     "obj": encode(obj),
+                                     "old": encode(old)
+                                     if old is not None else None})
+                if gap_kind is None:
+                    for kind in kinds:
+                        listener = listener_for(kind)
+                        listeners.append((kind, listener))
+                        store.watch(kind, listener,
+                                    replay=replay and since is None)
+                    enqueue({"stream": "synced",
+                             "rv": {k: store.last_event_rv(k)
+                                    for k in kinds}})
+            if gap_kind is not None:
+                send_frame(sock, {
+                    "ok": False, "error": "ResumeGapError",
+                    "message": f"resume window for {gap_kind!r} no longer "
+                               f"covers rv {since.get(gap_kind)}"})
+                return
             while not overflowed.is_set():
                 try:
                     payload = events.get(timeout=10.0)
@@ -292,6 +389,9 @@ class StoreServer:
         self._server.store = store  # type: ignore[attr-defined]
         self._server.token = token or ""  # type: ignore[attr-defined]
         self._server.ssl_ctx = ssl_ctx  # type: ignore[attr-defined]
+        # resume window for reconnecting watchers (see EventJournal)
+        self.journal = EventJournal(store)
+        self._server.journal = self.journal  # type: ignore[attr-defined]
         # live connection sockets, so stop() drops watch streams too
         # (daemon handler threads outlive server_close otherwise and
         # clients would never learn the server is gone)
@@ -313,6 +413,7 @@ class StoreServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self.journal.close()
         for sock in list(self._server.active):  # type: ignore[attr-defined]
             try:
                 sock.shutdown(socket.SHUT_RDWR)

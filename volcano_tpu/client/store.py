@@ -40,6 +40,12 @@ class ConflictError(Exception):
     """Stale-object write (resource_version mismatch)."""
 
 
+class ResumeGapError(Exception):
+    """A watch resume asked for events the server can no longer replay
+    (the journal's window moved past the client's high-water mark); the
+    client falls back to its crash-only resync path."""
+
+
 def _key(obj) -> str:
     ns = getattr(obj, "namespace", None)
     return f"{ns}/{obj.name}" if ns is not None else obj.name
@@ -60,6 +66,10 @@ class ClusterStore:
         self._interceptors: List[Interceptor] = []
         self._lock = threading.RLock()
         self._rv = 0
+        # global rv of the LAST event committed per kind — the watch-resume
+        # seam (server.EventJournal) needs "has anything happened to this
+        # kind since rv X" answerable without scanning a journal
+        self._kind_rv: Dict[str, int] = {k: 0 for k in KINDS}
 
     def locked(self):
         """The store's write lock, for callers that need a consistent
@@ -99,8 +109,15 @@ class ClusterStore:
                 pass
 
     def _notify(self, kind: str, event: str, obj, old=None) -> None:
+        self._kind_rv[kind] = self._rv
         for fn in list(self._listeners[kind]):
             fn(event, obj, old)
+
+    def last_event_rv(self, kind: str) -> int:
+        """Global resource_version at which this kind last committed an
+        event (0 = never). Deletes count: they bump the global rv too."""
+        with self._lock:
+            return self._kind_rv[kind]
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -162,6 +179,9 @@ class ClusterStore:
             if obj is None:
                 raise NotFoundError(f"{kind} {key} not found")
             self._admit("delete", kind, obj)
+            # deletes advance the global rv like every other event, so a
+            # resuming watcher's high-water mark orders them correctly
+            self._rv += 1
             self._notify(kind, "delete", obj)
             return obj
 

@@ -116,6 +116,16 @@ class Session:
         # overlap (False = strictly serial solve for parity testing)
         self.prewarmer = getattr(cache, "prewarmer", None)
         self.pipeline_solver = getattr(cache, "pipeline_solver", True)
+        # resilience seams: the device-path circuit breaker (installed on
+        # the cache by the Scheduler; consumed by allocate/evict_solver
+        # for the device -> host-oracle degradation ladder), plus the
+        # open-statement ledger + action epochs the scheduler's per-action
+        # containment uses to roll back a hung or throwing action's
+        # uncommitted transactions (see resilience/watchdog.py)
+        self.breaker = getattr(cache, "breaker", None)
+        self._open_statements: Dict[int, object] = {}
+        self._action_epoch = 0
+        self._contained_epochs: set = set()
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)
@@ -387,7 +397,28 @@ class Session:
 
     def statement(self, defer_events: bool = False):
         from .statement import Statement
-        return Statement(self, defer_events=defer_events)
+        stmt = Statement(self, defer_events=defer_events)
+        # ledger for containment sweeps; commit/discard remove themselves
+        self._open_statements[id(stmt)] = stmt
+        return stmt
+
+    def discard_open_statements(self) -> int:
+        """Containment sweep: discard every statement that was opened but
+        neither committed nor discarded, newest first — a contained
+        (throwing or timed-out) action's in-flight transactions must not
+        leak half-applied session state into the rest of the cycle.
+        Returns the number of statements that actually carried ops."""
+        stmts = list(self._open_statements.values())
+        self._open_statements.clear()
+        n = 0
+        for stmt in reversed(stmts):
+            try:
+                if stmt.operations:
+                    n += 1
+                stmt.discard()
+            except Exception:  # noqa: BLE001 — sweep every statement
+                log.exception("failed to discard a contained statement")
+        return n
 
     def _fire_allocate(self, task: TaskInfo) -> None:
         for eh in self.event_handlers:

@@ -43,6 +43,13 @@ class Statement:
         # loop keeps live events because its ordering decisions read
         # shares mid-flight.
         self.defer_events = defer_events
+        # containment bookkeeping: which action epoch opened this
+        # statement. A watchdog-contained (timed-out) action's zombie
+        # thread may call commit() long after the scheduler moved on; the
+        # epoch guard turns that late commit into a discard so nothing an
+        # abandoned action decided reaches the cluster (see
+        # resilience/watchdog.py).
+        self._epoch = getattr(ssn, "_action_epoch", 0)
 
     # -- evict --------------------------------------------------------------
 
@@ -240,8 +247,21 @@ class Statement:
 
     # -- transaction boundary ----------------------------------------------
 
+    def _close_ledger(self) -> None:
+        ledger = getattr(self.ssn, "_open_statements", None)
+        if ledger is not None:
+            ledger.pop(id(self), None)
+
     def commit(self) -> None:
         """Apply side effects (statement.go:370-388)."""
+        if self._epoch in getattr(self.ssn, "_contained_epochs", ()):
+            # the action that opened this statement was contained (it
+            # blew its deadline and was abandoned): its decisions were
+            # rolled back, so a zombie thread's late commit must discard
+            log.warning("discarding commit from a contained action")
+            self.discard()
+            return
+        self._close_ledger()
         acc = getattr(self.ssn, "_bulk_commit_acc", None)
         if acc is not None and self.defer_events and self.operations \
                 and getattr(self.ssn.cache, "bind_batch", None) is not None \
@@ -305,6 +325,7 @@ class Statement:
 
     def discard(self) -> None:
         """Reverse-order undo (statement.go:345-367)."""
+        self._close_ledger()
         # a discarded statement must leave nothing in the bulk-commit
         # window (its ops were never accumulated — commit() is the only
         # writer — so plain reverse-undo below is complete)

@@ -245,6 +245,37 @@ session_phase_ms = registry.register(Gauge(
     "volcano_session_phase_milliseconds",
     "Per-phase latency of the last scheduling cycle", ["phase"]))
 
+# -- resilience metrics (resilience/, scheduler containment, store client) --
+
+breaker_state = registry.register(Gauge(
+    "volcano_breaker_state",
+    "Circuit breaker state (0=closed, 1=half_open, 2=open)", ["breaker"]))
+breaker_transitions_total = registry.register(Counter(
+    "volcano_breaker_transitions_total",
+    "Circuit breaker state transitions", ["breaker", "to"]))
+breaker_fallback_cycles_total = registry.register(Counter(
+    "volcano_breaker_fallback_cycles_total",
+    "Scheduling cycles served by the host oracle while the device "
+    "breaker was not closed", ["breaker"]))
+conf_load_errors = registry.register(Counter(
+    "volcano_conf_load_errors",
+    "Scheduler conf hot-reload failures (last good conf retained)"))
+action_failures_total = registry.register(Counter(
+    "volcano_action_failures_total",
+    "Scheduling actions contained after raising", ["action"]))
+action_timeouts_total = registry.register(Counter(
+    "volcano_action_timeouts_total",
+    "Scheduling actions contained after a deadline breach", ["action"]))
+watch_reconnects_total = registry.register(Counter(
+    "volcano_watch_reconnects_total",
+    "Watch streams resumed in place after a break", ["kind"]))
+store_request_retries_total = registry.register(Counter(
+    "volcano_store_request_retries_total",
+    "Store client requests retried after a connection failure"))
+faults_injected_total = registry.register(Counter(
+    "volcano_faults_injected_total",
+    "Faults fired by the injection harness", ["point"]))
+
 # -- job / namespace metrics -----------------------------------------------
 
 job_share = registry.register(Gauge(

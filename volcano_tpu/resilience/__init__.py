@@ -1,0 +1,24 @@
+"""Resilience subsystem: every single-point failure degrades, none are fatal.
+
+Three coordinated pieces plus the harness that proves them:
+
+- ``breaker.CircuitBreaker`` — the device-solver circuit breaker
+  (device -> host-oracle degradation ladder; wired by the Scheduler into
+  the cache, consumed by actions/allocate.py and actions/evict_solver.py);
+- ``watchdog.ActionWatchdog`` — per-action deadline containment for the
+  session loop (scheduler.py), with faulthandler dumps on breach;
+- watch-stream resume lives with the transport it hardens
+  (client/server.py ``EventJournal`` + client/remote.py reconnect), with
+  the crash-only ``on_watch_failure`` contract kept as its fallback;
+- ``faultinject.faults`` — the deterministic, seeded fault-injection
+  harness driving tests/test_resilience.py and ``bench.py chaos_churn``.
+"""
+
+from .breaker import CircuitBreaker
+from .faultinject import FaultError, FaultInjector, faults
+from .watchdog import ActionTimeout, ActionWatchdog
+
+__all__ = [
+    "ActionTimeout", "ActionWatchdog", "CircuitBreaker",
+    "FaultError", "FaultInjector", "faults",
+]

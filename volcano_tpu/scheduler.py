@@ -18,6 +18,7 @@ from .cache import SchedulerCache
 from .conf import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
 from .framework import close_session, get_action, open_session
 from .metrics import metrics
+from .resilience import ActionTimeout
 
 log = logging.getLogger(__name__)
 
@@ -32,7 +33,10 @@ class Scheduler:
                  percentage_of_nodes_to_find: int = 100,
                  compile_cache_dir: Optional[str] = None,
                  prewarm: bool = False,
-                 pipeline_solver: bool = True):
+                 pipeline_solver: bool = True,
+                 action_deadline_s: Optional[float] = None,
+                 breaker_failures: int = 3,
+                 breaker_cooldown_s: float = 30.0):
         # adaptive host-loop node sampling knob, instance-scoped
         # (cmd/scheduler/app/options/options.go:37-40)
         from .utils import NodeSampler
@@ -42,10 +46,24 @@ class Scheduler:
         self.conf_path = conf_path
         self._conf_mtime = 0.0
         self._conf_text = scheduler_conf or DEFAULT_SCHEDULER_CONF
+        self._conf_bad_text: Optional[str] = None
         self.actions = []
         self.tiers = []
         self.configurations = []
         self.load_conf()
+        # resilience wiring (volcano_tpu.resilience): the device-path
+        # circuit breaker lives on the CACHE so sessions and all
+        # solver-dispatching actions share one failure account, and the
+        # optional per-action deadline watchdog contains hung actions
+        # (None = actions run inline, exactly the pre-watchdog path)
+        from .resilience import ActionWatchdog, CircuitBreaker
+        if getattr(cache, "breaker", None) is None:
+            cache.breaker = CircuitBreaker(
+                "device-solver", failure_threshold=breaker_failures,
+                cooldown_s=breaker_cooldown_s)
+        self.action_deadline_s = action_deadline_s
+        self._watchdog = ActionWatchdog(action_deadline_s) \
+            if action_deadline_s else None
         # compile-and-dispatch pipeline (ops.precompile): persistent
         # on-disk XLA executable cache (explicit dir or
         # $VOLCANO_COMPILE_CACHE_DIR), background next-bucket pre-warm,
@@ -72,14 +90,29 @@ class Scheduler:
                 self._conf_mtime = mtime
                 with open(self.conf_path) as f:
                     text = f.read()
-                self._conf_text = text
-        conf = load_scheduler_conf(text)
-        acts = []
-        for name in conf.actions:
-            action = get_action(name)
-            if action is None:
-                raise ValueError(f"failed to find action {name}")
-            acts.append(action)
+        if text == self._conf_bad_text:
+            return  # known-bad reload, already logged: keep the last good
+        try:
+            conf = load_scheduler_conf(text)
+            acts = []
+            for name in conf.actions:
+                action = get_action(name)
+                if action is None:
+                    raise ValueError(f"failed to find action {name}")
+                acts.append(action)
+        except Exception:
+            if not self.actions:
+                raise  # first load: there is no last-good conf to keep
+            # last-good retention: a malformed hot-reloaded conf must not
+            # raise out of every cycle until someone fixes the file —
+            # keep scheduling on the previous conf, log once per change
+            self._conf_bad_text = text
+            metrics.conf_load_errors.inc()
+            log.exception("scheduler conf reload failed; keeping the "
+                          "last good conf")
+            return
+        self._conf_bad_text = None
+        self._conf_text = text
         self.actions = acts
         self.tiers = conf.tiers
         self.configurations = conf.configurations
@@ -124,13 +157,41 @@ class Scheduler:
         t_open = time.perf_counter()
         timing["open_ms"] = (t_open - t0) * 1e3
         try:
-            for action in self.actions:
+            for epoch, action in enumerate(self.actions):
                 ta = time.perf_counter()
-                action.execute(ssn)
+                name = action.name()
+                ssn._action_epoch = epoch
+                try:
+                    self._execute_action(ssn, action)
+                except ActionTimeout:
+                    # deadline breach: the watchdog already dumped stacks;
+                    # roll the abandoned action's statements back, fence
+                    # its epoch so a zombie commit becomes a discard, and
+                    # run the REMAINING actions of this cycle
+                    ssn._contained_epochs.add(epoch)
+                    n = ssn.discard_open_statements()
+                    timing[f"{name}_timeout"] = 1.0
+                    metrics.action_timeouts_total.inc(
+                        labels={"action": name})
+                    log.error("action %s exceeded its deadline; contained "
+                              "(%d open statement(s) discarded), running "
+                              "the remaining actions", name, n)
+                except Exception:
+                    # a throwing action is contained the same way: its
+                    # uncommitted statements discard and the cycle goes on
+                    # (the reference contains per-cycle errors identically
+                    # — one bad action must not starve backfill forever)
+                    n = ssn.discard_open_statements()
+                    timing[f"{name}_error"] = 1.0
+                    metrics.action_failures_total.inc(
+                        labels={"action": name})
+                    log.exception("action %s failed; contained (%d open "
+                                  "statement(s) discarded), running the "
+                                  "remaining actions", name, n)
                 dt = time.perf_counter() - ta
-                timing[f"{action.name()}_ms"] = dt * 1e3
+                timing[f"{name}_ms"] = dt * 1e3
                 metrics.action_scheduling_latency.observe(
-                    dt * 1e6, labels={"action": action.name()})
+                    dt * 1e6, labels={"action": name})
             # the allocate action's internal decomposition when it ran in
             # solver mode (flatten/solve/replay)
             for k, v in (ssn.solver_options.get("timing") or {}).items():
@@ -144,6 +205,20 @@ class Scheduler:
         self._export_pipeline_metrics(timing)
         self.last_cycle_timing = timing
         metrics.e2e_scheduling_latency.observe(total)
+
+    def _execute_action(self, ssn, action) -> None:
+        """Run one action, inline or under the deadline watchdog; the
+        slow_action fault point lets the chaos harness simulate a hang."""
+        from .resilience import faults
+
+        def run():
+            faults.fire("slow_action")
+            action.execute(ssn)
+
+        if self._watchdog is None:
+            run()
+        else:
+            self._watchdog.run(action.name(), run)
 
     #: timing keys exported per cycle as the volcano_session_phase_ms
     #: gauge — the flatten/upload/solve/replay decomposition the compile
@@ -172,6 +247,12 @@ class Scheduler:
         pw = getattr(self.cache, "prewarmer", None)
         if pw is not None:
             timing["prewarm_completions"] = float(pw.completions)
+        br = getattr(self.cache, "breaker", None)
+        if br is not None:
+            # the degradation ladder made observable per cycle: 0=closed
+            # (device path live), 1=half-open probe, 2=open (host oracle)
+            timing["breaker_state"] = float(br.state_code)
+            timing["breaker_fallback_cycles"] = float(br.fallback_cycles)
 
     def run_with_leader_election(self, stop, lock_name: str = "volcano",
                                  identity: Optional[str] = None,

@@ -47,7 +47,10 @@ class Standalone:
                  compile_cache_dir: Optional[str] = None,
                  prewarm: bool = False,
                  pipeline_solver: bool = True,
-                 pipeline_effects: bool = False):
+                 pipeline_effects: bool = False,
+                 action_deadline_s: Optional[float] = None,
+                 breaker_failures: int = 3,
+                 breaker_cooldown_s: float = 30.0):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -137,7 +140,10 @@ class Standalone:
             self.cache, scheduler_conf=scheduler_conf, period=period,
             percentage_of_nodes_to_find=percentage_of_nodes_to_find,
             compile_cache_dir=compile_cache_dir, prewarm=prewarm,
-            pipeline_solver=pipeline_solver)
+            pipeline_solver=pipeline_solver,
+            action_deadline_s=action_deadline_s,
+            breaker_failures=breaker_failures,
+            breaker_cooldown_s=breaker_cooldown_s)
         # pipeline_effects: don't drain the async bind effectors between
         # control-plane turns — cycle N's API writes overlap cycle N+1's
         # snapshot+flatten (see Scheduler.run). Off by default: embedding
@@ -250,6 +256,19 @@ def main(argv=None) -> int:
                     help="overlap async bind writes with the next "
                          "control-plane turn instead of draining between "
                          "turns")
+    ap.add_argument("--action-deadline", type=float, default=None,
+                    metavar="SECS",
+                    help="contain any scheduling action exceeding this "
+                         "deadline (faulthandler stack dump + statement "
+                         "discard; remaining actions still run). Default: "
+                         "no deadline")
+    ap.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive device-solver failures that open "
+                         "the circuit breaker (host-oracle fallback)")
+    ap.add_argument("--breaker-cooldown", type=float, default=30.0,
+                    metavar="SECS",
+                    help="seconds the breaker stays open before a "
+                         "half-open probe re-tries the device path")
     args = ap.parse_args(argv)
 
     conf = None
@@ -270,7 +289,10 @@ def main(argv=None) -> int:
                     compile_cache_dir=args.compile_cache_dir,
                     prewarm=args.prewarm,
                     pipeline_solver=not args.serial_solver,
-                    pipeline_effects=args.pipeline_effects)
+                    pipeline_effects=args.pipeline_effects,
+                    action_deadline_s=args.action_deadline,
+                    breaker_failures=args.breaker_failures,
+                    breaker_cooldown_s=args.breaker_cooldown)
     if args.jobs_dir:
         import glob
         import os
