@@ -1175,6 +1175,54 @@ def chaos_churn():
     }
 
 
+def sim_quality():
+    """Scheduling-quality A/B on the trace-driven simulator (PR-4
+    acceptance config): the SAME seeded workload — >=500 virtual cycles,
+    >=5k pods — run against the host oracle, the device solver, and the
+    sharded (D=1 mesh) solver, each scored on job wait (mean/p99),
+    utilization, Jain fairness across weighted queues, and preemption
+    churn. Per-arm fault isolation: one arm crashing records an error
+    field, the others' scores survive."""
+    from volcano_tpu.sim import run_sim
+    from volcano_tpu.sim.workload import Workload, WorkloadSpec
+
+    cycles = 500
+    # sized to saturation (~0.9 mean utilization: 14 pods/cycle x ~2.3
+    # cpu x ~22 cycle lifetime vs 22x32 cpu) so jobs actually queue —
+    # wait_mean ~8 cycles, p99 ~60 on the host arm — and the wait/
+    # fairness metrics discriminate between solver arms
+    spec = WorkloadSpec(
+        seed=123, cycles=cycles, nodes=22, node_cpu="32",
+        arrival_rate=4.0, gang_min=2, gang_max=5,
+        duration_min=5, duration_max=40,
+        queues=(("q0", 1), ("q1", 2), ("q2", 3)))
+    workload = Workload(spec)
+    out = {"cycles": cycles, "pods": workload.total_pods,
+           "jobs": len(workload.events), "seed": spec.seed}
+    digests = {}
+    for arm, mode in (("host", "host"), ("device", "solver"),
+                      ("sharded", "sharded")):
+        t0 = time.perf_counter()
+        try:
+            r = run_sim(workload=workload, cycles=cycles, mode=mode,
+                        drain=100)
+            digests[arm] = r.digest
+            out[arm] = {
+                "score": r.score,
+                "digest": r.digest,
+                "wall_s": round(time.perf_counter() - t0, 1),
+            }
+        except Exception as e:  # noqa: BLE001 — per-arm isolation
+            out[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    # do the two device-path arms make identical decisions? (the D=1
+    # sharded kernel is proven bitwise-equal at the solve level; this
+    # pins it end-to-end through the full cycle)
+    if "device" in digests and "sharded" in digests:
+        out["device_vs_sharded_identical"] = \
+            digests["device"] == digests["sharded"]
+    return out
+
+
 _TRANSIENT_MARKERS = (
     "remote_compile", "read body", "connection", "Connection", "socket",
     "UNAVAILABLE", "DEADLINE", "timed out", "timeout", "closed",
@@ -1210,9 +1258,8 @@ def _run_config(name, fn, retries: int = 1):
             }
 
 
-def main() -> int:
+def _main_inner() -> dict:
     t_setup = time.time()
-    import jax
 
     h = _run_config("headline", headline)
     headline_ok = "error" not in h
@@ -1227,16 +1274,18 @@ def main() -> int:
         ("full_cycle_10k_2k", full_cycle),
         ("steady_churn_1p5k_400", steady_churn),
         ("chaos_churn_50", chaos_churn),
+        ("sim_quality_500c", sim_quality),
     ):
         configs[name] = _run_config(name, fn)
     setup_s = time.time() - t_setup
 
     try:
+        import jax
         device = str(jax.devices()[0])
     except Exception as e:  # noqa: BLE001
         device = f"unavailable: {e}"
     p50 = h.pop("p50_ms", None) if headline_ok else None
-    result = {
+    return {
         "metric": "p50 session latency @10k pods/2k nodes",
         "value": p50,
         "unit": "ms",
@@ -1248,7 +1297,35 @@ def main() -> int:
             "device": device,
         },
     }
-    print(json.dumps(result))
+
+
+def main() -> int:
+    """Always exits 0 with ONE JSON line on stdout — a crash anywhere
+    (jax import, a config escaping its wrapper, serialization) downgrades
+    to an {"error": ...} artifact instead of rc!=0 with no JSON
+    (BENCH_r05's `rc=1, parsed=null` failure mode)."""
+    import traceback
+
+    try:
+        result = _main_inner()
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the artifact IS the report
+        result = {
+            "metric": "p50 session latency @10k pods/2k nodes",
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}".strip()[:500],
+            "traceback_tail":
+                traceback.format_exc().strip().splitlines()[-3:],
+        }
+    try:
+        print(json.dumps(result))
+    except (TypeError, ValueError) as e:
+        print(json.dumps({"metric": "p50 session latency @10k pods/2k "
+                                    "nodes", "value": None,
+                          "error": f"artifact not serializable: {e}"}))
     return 0
 
 

@@ -163,7 +163,8 @@ class AllocateAction(Action):
     # solver mode
     # ------------------------------------------------------------------
 
-    def _execute_solver(self, ssn, sequential: bool = False) -> None:
+    def _execute_solver(self, ssn, sequential: bool = False,
+                        sharded: bool = False) -> None:
         import time as _time
 
         from ..ops import flatten_snapshot, solve_allocate, \
@@ -290,6 +291,38 @@ class AllocateAction(Action):
                     arr.device_dict(), params, score_families=families,
                     use_queue_cap=use_queue_cap,
                     work_conserving=work_conserving)
+            elif sharded:
+                # mode: sharded — the shard_map solver on a 1-device mesh
+                # over the same packed device-resident form the
+                # single-device dispatch uses. The sim's scheduling-quality
+                # A/B runs this arm against the host oracle and the plain
+                # device solver on the same seed; multi-chip deployments
+                # get the identical code path with a wider mesh.
+                import jax
+
+                from ..parallel import (
+                    make_mesh, solve_allocate_sharded_packed2d,
+                )
+                fbuf, ibuf, layout = arr.packed()
+                if dc is not None:
+                    f2d, i2d = dc.update(fbuf, ibuf, layout)
+                    params = dc.params_device(params)
+                else:
+                    from ..ops.device_cache import PackedDeviceCache
+                    f2d, i2d = PackedDeviceCache().update(fbuf, ibuf, layout)
+                    params = {k: jax.device_put(np.asarray(v))
+                              for k, v in params.items()}
+                r = solve_allocate_sharded_packed2d(
+                    f2d, i2d, layout, params,
+                    make_mesh(jax.devices()[:1]), herd_mode=herd,
+                    score_families=families, use_queue_cap=use_queue_cap,
+                    use_drf_order=use_drf_order,
+                    use_hdrf_order=use_hdrf_order)
+                # SolveResult.compact is not produced by the sharded
+                # kernel; collect assigned/kind directly (sidecar shape)
+                assigned = np.asarray(r.assigned)
+                kind = np.asarray(r.kind)
+                res = None
             elif sidecar is not None:
                 # process boundary: ship the packed snapshot to the solver
                 # sidecar (which owns the TPU) and replay its assignments
@@ -750,7 +783,8 @@ class AllocateAction(Action):
         if mode == "host":
             self._execute_host(ssn)
             return
-        self._execute_solver(ssn, sequential=(mode == "sequential"))
+        self._execute_solver(ssn, sequential=(mode == "sequential"),
+                             sharded=(mode == "sharded"))
         host_only = ssn.solver_options.get("host_only_jobs")
         if host_only:
             # host-only jobs ranked after some device-path job place via

@@ -36,6 +36,34 @@ class FitError:
     __str__ = error
 
 
+def aggregate_fit_errors(fit_errors_by_task: Dict[str, "FitErrors"],
+                         total_tasks: int) -> str:
+    """Aggregate a job's per-task FitErrors into the stable, deduplicated
+    summary the reference posts as the PodGroup event message:
+    ``"x/y tasks unschedulable: reason (count), ..."``.
+
+    Each task contributes every DISTINCT reason once (a task failing the
+    same predicate on 500 nodes counts one, not 500), counts are the
+    number of tasks citing the reason, and the ordering is count-desc
+    then alphabetical — byte-stable across runs, so the sim recorder can
+    put it in golden traces and ``vcctl sim`` can print it verbatim."""
+    hist: Dict[str, int] = {}
+    for fe in fit_errors_by_task.values():
+        if fe.err:
+            reasons = {fe.err}
+        elif fe.nodes:
+            reasons = {r for node_fe in fe.nodes.values()
+                       for r in node_fe.reasons}
+        else:
+            reasons = {ALL_NODES_UNAVAILABLE}
+        for r in reasons:
+            hist[r] = hist.get(r, 0) + 1
+    parts = [f"{r} ({c})"
+             for r, c in sorted(hist.items(), key=lambda kv: (-kv[1], kv[0]))]
+    return (f"{len(fit_errors_by_task)}/{total_tasks} tasks unschedulable: "
+            f"{', '.join(parts)}")
+
+
 class FitErrors:
     """Per-task collection of per-node fit errors, histogrammed for the
     PodGroup condition message."""

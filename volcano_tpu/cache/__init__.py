@@ -6,5 +6,6 @@ from .cache import (  # noqa: F401
 )
 from .fakes import (  # noqa: F401
     FakeBinder, FakeEvictor, FakeStatusUpdater, FakeVolumeBinder,
+    RecordingBinder, RecordingEvictor,
 )
 from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder  # noqa: F401

@@ -31,6 +31,49 @@ class FakeEvictor:
         self.channel.append(key)
 
 
+class RecordingBinder:
+    """FakeBinder generalized into a decorator: record every bind like
+    FakeBinder does AND forward to an inner binder (``inner=None`` keeps
+    pure FakeBinder semantics). ``on_bind(pod, hostname)`` is the sim
+    decision-recorder seam — it fires only after the inner binder
+    succeeded, so recorded binds are exactly the ones that reached the
+    cluster."""
+
+    def __init__(self, inner=None, on_bind=None):
+        self.inner = inner
+        self.on_bind = on_bind
+        self.binds: Dict[str, str] = {}
+        self.channel: List[str] = []
+
+    def bind(self, pod, hostname: str) -> None:
+        if self.inner is not None:
+            self.inner.bind(pod, hostname)
+        key = f"{pod.namespace}/{pod.name}"
+        self.binds[key] = hostname
+        self.channel.append(key)
+        if self.on_bind is not None:
+            self.on_bind(pod, hostname)
+
+
+class RecordingEvictor:
+    """FakeEvictor as a decorator (see RecordingBinder)."""
+
+    def __init__(self, inner=None, on_evict=None):
+        self.inner = inner
+        self.on_evict = on_evict
+        self.evicts: List[str] = []
+        self.channel: List[str] = []
+
+    def evict(self, pod, reason: str) -> None:
+        if self.inner is not None:
+            self.inner.evict(pod, reason)
+        key = f"{pod.namespace}/{pod.name}"
+        self.evicts.append(key)
+        self.channel.append(key)
+        if self.on_evict is not None:
+            self.on_evict(pod, reason)
+
+
 class FakeStatusUpdater:
     def update_pod_condition(self, pod, condition: dict) -> None:
         pass

@@ -298,6 +298,56 @@ def queue_delete(args, cluster: ClusterStore) -> str:
 
 
 # ---------------------------------------------------------------------------
+# sim command (volcano_tpu.sim: trace-driven scheduling-quality harness)
+# ---------------------------------------------------------------------------
+
+def sim_cmd(args, cluster: ClusterStore) -> str:
+    """Run the deterministic cluster simulator (record / verify / score).
+    Self-contained: the sim builds its own virtual cluster, so the
+    --server store (if any) is not touched."""
+    import json
+
+    from ..sim import replay as sim_replay
+    from ..sim.workload import Workload, WorkloadSpec
+
+    spec = WorkloadSpec(seed=args.seed, cycles=args.cycles,
+                        nodes=args.nodes, arrival_rate=args.rate,
+                        fail_fraction=args.fail_fraction)
+    workload = Workload.load(args.trace) if args.trace else Workload(spec)
+
+    if args.verify:
+        rep = sim_replay.verify(args.verify, workload=workload,
+                                cycles=args.cycles, mode=args.mode,
+                                drain=args.drain)
+        status = "replay OK (byte-identical)" if rep["ok"] \
+            else "replay DIVERGED"
+        out = [f"{status}: {rep['cycles']} cycles, digest {rep['digest']}"]
+        if rep["divergence"] is not None:
+            out.append(json.dumps(rep["divergence"], sort_keys=True))
+        return "\n".join(out)
+
+    result = sim_replay.run_sim(workload=workload, cycles=args.cycles,
+                                mode=args.mode, drain=args.drain,
+                                record_path=args.record)
+    sc = result.score
+    out = [
+        f"sim: {sc['cycles']} cycles, mode={args.mode}, seed={args.seed}",
+        f"jobs: {sc['jobs_arrived']} arrived, {sc['jobs_served']} served, "
+        f"{sc['jobs_completed']} completed; {sc['pods_bound']} pods bound",
+        f"digest: {result.digest}",
+    ]
+    # the aggregated FitErrors summaries ("x/y tasks unschedulable: ...")
+    # from the final cycle — the same strings the recorder traces
+    last = result.vc.recorder.last_record() or {}
+    for job, msg in sorted((last.get("unschedulable") or {}).items()):
+        out.append(f"unschedulable {job}: {msg}")
+    if args.record:
+        out.append(f"trace recorded to {args.record}")
+    out.append(json.dumps({"score": sc}, sort_keys=True))
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
 
@@ -353,6 +403,22 @@ def build_parser() -> argparse.ArgumentParser:
     applyp = sub.add_parser("apply")
     applyp.add_argument("--filename", "-f", required=True)
 
+    simp = sub.add_parser(
+        "sim", help="trace-driven cluster simulator "
+                    "(record/replay/score scheduling quality)")
+    simp.add_argument("--cycles", type=int, default=100)
+    simp.add_argument("--seed", type=int, default=0)
+    simp.add_argument("--mode", default="solver",
+                      choices=["solver", "host", "sequential", "sharded"])
+    simp.add_argument("--nodes", type=int, default=8)
+    simp.add_argument("--rate", type=float, default=1.5)
+    simp.add_argument("--fail-fraction", type=float, default=0.0,
+                      dest="fail_fraction")
+    simp.add_argument("--drain", type=int, default=0)
+    simp.add_argument("--record", metavar="PATH", default=None)
+    simp.add_argument("--verify", metavar="PATH", default=None)
+    simp.add_argument("--trace", metavar="PATH", default=None)
+
     sub.add_parser("version")
     return p
 
@@ -370,6 +436,7 @@ _DISPATCH = {
     ("queue", "operate"): queue_operate,
     ("queue", "delete"): queue_delete,
     ("apply", None): apply_file,
+    ("sim", None): sim_cmd,
 }
 
 #: standalone binary aliases (cmd/cli/{vsub,vjobs,...})

@@ -25,8 +25,13 @@ class KubeletStandin(Controller):
     stand-off between a saturated queue and its claimant never converges —
     the same attrition dynamic a real cluster gets from kubelet timing."""
 
-    def __init__(self, grace_seconds: float = 30.0):
+    def __init__(self, grace_seconds: float = 30.0, clock=time.time):
+        # clock is the kubelet's time source: wall clock in a live control
+        # plane, the virtual clock in the trace-driven simulator
+        # (volcano_tpu.sim.virtualcluster) so termination grace elapses in
+        # virtual seconds and runs stay reproducible
         self.grace_seconds = grace_seconds
+        self.clock = clock
         self.cluster = None
 
     def name(self) -> str:
@@ -39,7 +44,7 @@ class KubeletStandin(Controller):
         pass  # no watches: termination is scanned, like kubelet sync loops
 
     def process_all(self) -> None:
-        now = time.time()
+        now = self.clock()
         for pod in list(self.cluster.list("pods")):
             ts = pod.deletion_timestamp
             if ts is None or now < ts + self.grace_seconds:

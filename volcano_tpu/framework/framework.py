@@ -47,6 +47,17 @@ def close_session(ssn: Session) -> None:
         plugin.on_session_close(ssn)
         _metrics_plugin(name, "OnSessionClose", t0)
 
+    # decision-trace hook: the recorder reads the session AFTER plugins
+    # closed (conditions/fit errors final) and BEFORE teardown — this is
+    # where pipeline statements and per-job unschedulability summaries
+    # enter the sim's golden trace (sim/recorder.py)
+    rec = getattr(ssn, "decision_recorder", None)
+    if rec is not None:
+        try:
+            rec.observe_session(ssn)
+        except Exception:
+            log.exception("decision recorder observe_session failed")
+
     ju = JobUpdater(ssn)
     ju.update_all()
 

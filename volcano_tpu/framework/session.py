@@ -126,6 +126,12 @@ class Session:
         self._open_statements: Dict[int, object] = {}
         self._action_epoch = 0
         self._contained_epochs: set = set()
+        # decision-trace seam (sim.recorder.DecisionRecorder): when the
+        # cache carries a recorder, close_session hands it the finished
+        # session so pipeline statements and per-job FitErrors reach the
+        # trace; binds/evicts are captured at the effector boundary
+        # (cache.RecordingBinder/RecordingEvictor)
+        self.decision_recorder = getattr(cache, "decision_recorder", None)
 
     # ------------------------------------------------------------------
     # registration API used by plugins (session_plugins.go:26-118)

@@ -276,6 +276,18 @@ faults_injected_total = registry.register(Counter(
     "volcano_faults_injected_total",
     "Faults fired by the injection harness", ["point"]))
 
+# -- cluster simulator metrics (sim/) ---------------------------------------
+
+sim_cycles_total = registry.register(Counter(
+    "volcano_sim_cycles_total",
+    "Virtual scheduling cycles executed by the cluster simulator"))
+sim_decisions_total = registry.register(Counter(
+    "volcano_sim_decisions_total",
+    "Decisions captured by the sim decision recorder", ["kind"]))
+sim_replay_divergences_total = registry.register(Counter(
+    "volcano_sim_replay_divergences_total",
+    "Golden-trace verifications that found a divergence"))
+
 # -- job / namespace metrics -----------------------------------------------
 
 job_share = registry.register(Gauge(

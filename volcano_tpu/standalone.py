@@ -50,7 +50,9 @@ class Standalone:
                  pipeline_effects: bool = False,
                  action_deadline_s: Optional[float] = None,
                  breaker_failures: int = 3,
-                 breaker_cooldown_s: float = 30.0):
+                 breaker_cooldown_s: float = 30.0,
+                 sim_record: Optional[str] = None,
+                 sim_trace: Optional[str] = None):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -128,6 +130,50 @@ class Standalone:
         self.cache = SchedulerCache(self.store,
                                     scheduler_name=scheduler_name,
                                     async_effectors=async_effectors)
+        # --sim-record: attach the sim's decision recorder to the LIVE
+        # control plane — every cycle's binds/evicts/pipelines/FitErrors
+        # append to the JSONL trace (non-strict: live traces timestamp
+        # with wall time; reproducibility is the virtual-clock sim's job)
+        self._turn = 0
+        self.sim_recorder = None
+        self._sim_record_file = None
+        if sim_record:
+            from .cache import RecordingBinder, RecordingEvictor
+            from .sim.recorder import DecisionRecorder
+            self._sim_record_file = open(sim_record, "a")
+            rec = DecisionRecorder(clock=lambda: time.time(),
+                                   sink=self._sim_record_file,
+                                   strict=False)
+            self.sim_recorder = rec
+            self.cache.decision_recorder = rec
+            self.cache.binder = RecordingBinder(
+                self.cache.binder,
+                on_bind=lambda pod, h: rec.record_bind(
+                    f"{pod.namespace}/{pod.name}", h))
+            self.cache.evictor = RecordingEvictor(
+                self.cache.evictor,
+                on_evict=lambda pod, r: rec.record_evict(
+                    f"{pod.namespace}/{pod.name}", r))
+        # --sim-trace: drive this control plane from a recorded workload
+        # trace (sim/workload.py JSONL) — each control-plane turn submits
+        # the events whose arrival cycle has come due
+        self._sim_events = []
+        if sim_trace:
+            from .sim.workload import Workload
+            wl = Workload.load(sim_trace)
+            self._sim_events = sorted(wl.events, key=lambda e: int(e["t"]))
+            # the trace's queues/priority classes must exist before its
+            # jobs are admitted (the jobs webhook rejects unknown queues),
+            # and the header's node pool is materialized so the trace is
+            # actually runnable — in standalone the ClusterStore IS the
+            # cluster, there are no real kubelets to register nodes
+            for q in wl.queue_objects():
+                self.store.apply("queues", q)
+            for pc in wl.priority_class_objects():
+                self.store.apply("priorityclasses", pc)
+            for node in wl.node_objects():
+                if self.store.try_get("nodes", node.name) is None:
+                    self.store.create("nodes", node)
         if sidecar_path:
             from .parallel.sidecar import SidecarSolver
             self.cache.sidecar = SidecarSolver(sidecar_path)
@@ -158,11 +204,24 @@ class Standalone:
         """One control-plane turn: controllers drain, scheduler cycles.
         ``drain_effects=False`` (the run() loop under pipeline_effects)
         leaves async binds in flight so they overlap the next turn."""
+        while self._sim_events and int(self._sim_events[0]["t"]) \
+                <= self._turn:
+            # --sim-trace arrivals due this turn, submitted as Jobs so
+            # they take the full admission + job-controller path
+            from .sim.workload import build_job_crd
+            self.store.create("jobs",
+                              build_job_crd(self._sim_events.pop(0)))
+        rec = self.sim_recorder
+        if rec is not None:
+            rec.begin_cycle(self._turn)
         self.controllers.process_all()
         self.scheduler.run_once()
         self.controllers.process_all()
         if drain_effects:
             self.cache.wait_for_effects()
+        if rec is not None:
+            rec.end_cycle(self.scheduler.last_cycle_timing)
+        self._turn += 1
 
     def run(self) -> None:
         if self.leader_elect:
@@ -193,6 +252,9 @@ class Standalone:
     def stop(self) -> None:
         self._stop.set()
         self.cache.wait_for_effects()  # land in-flight pipelined binds
+        if self._sim_record_file is not None:
+            self._sim_record_file.close()
+            self._sim_record_file = None
         self.metrics_server.stop()
         if self.store_server is not None:
             self.store_server.stop()
@@ -269,6 +331,15 @@ def main(argv=None) -> int:
                     metavar="SECS",
                     help="seconds the breaker stays open before a "
                          "half-open probe re-tries the device path")
+    ap.add_argument("--sim-record", metavar="PATH",
+                    help="append every cycle's decision record (binds/"
+                         "evictions/pipelines/FitErrors, breaker state) "
+                         "to PATH as JSONL — the live counterpart of the "
+                         "simulator's golden traces")
+    ap.add_argument("--sim-trace", metavar="PATH",
+                    help="drive this control plane from a sim workload "
+                         "trace (volcano_tpu.sim JSONL): arrivals submit "
+                         "as Jobs when their cycle comes due")
     args = ap.parse_args(argv)
 
     conf = None
@@ -292,7 +363,9 @@ def main(argv=None) -> int:
                     pipeline_effects=args.pipeline_effects,
                     action_deadline_s=args.action_deadline,
                     breaker_failures=args.breaker_failures,
-                    breaker_cooldown_s=args.breaker_cooldown)
+                    breaker_cooldown_s=args.breaker_cooldown,
+                    sim_record=args.sim_record,
+                    sim_trace=args.sim_trace)
     if args.jobs_dir:
         import glob
         import os
