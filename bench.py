@@ -36,6 +36,13 @@ circuit breaker), always emitting per-fault outcome fields
 (fired/resumed/retried/host_fallback) plus the breaker's recovery trace
 and a bind-for-bind comparison of the post-fault tail against the
 no-fault run.
+
+``failover`` is the crash-safe HA acceptance run: two scheduler
+PROCESSES under leader election on a networked store, the leader
+SIGKILLed mid-wave; records takeover latency (kill -> first standby
+bind, and lease-expiry -> first bind) and the first-post-takeover
+cycle's solve time + session-thread compile count, WARM standby
+(shadow cycles) vs COLD as an A/B.
 """
 
 from __future__ import annotations
@@ -1175,6 +1182,169 @@ def chaos_churn():
     }
 
 
+def failover():
+    """Kill-the-leader takeover latency + warm-vs-cold standby A/B (see
+    module docstring). Two ha_scheduler_proc processes contend on a
+    1-second lease over a StoreServer; the driver submits fixed gang
+    waves, SIGKILLs the leader while a wave is in flight, and reads the
+    survivor's pinned first-leader-cycle report (compiles/solve/total)
+    plus the bind timestamps from a store interceptor."""
+    import os
+    import subprocess
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from helpers import build_node, build_pod, build_pod_group, build_queue
+    from volcano_tpu.client import ClusterStore, StoreServer
+    from volcano_tpu.client.store import NotFoundError
+    from volcano_tpu.models import PodGroupPhase
+
+    LEASE = 1.0
+    WARMUP_WAVES, JOBS, TPJ, NODES = 6, 3, 2, 6
+
+    def run(warm: bool):
+        store = ClusterStore()
+        binds = []  # (t, pod, node) on unbound -> bound transitions
+
+        def audit(verb, kind, obj):
+            if kind == "pods" and verb == "update" and obj.node_name:
+                prev = store.try_get("pods", obj.name, obj.namespace)
+                if prev is None or prev is obj or not prev.node_name:
+                    binds.append((time.time(), obj.name, obj.node_name))
+            return obj
+
+        store.add_interceptor(audit)
+        server = StoreServer(store).start()
+        store.apply("queues", build_queue("q0", weight=1))
+        for i in range(NODES):
+            store.create("nodes", build_node(
+                f"n{i}", {"cpu": "16", "memory": "64Gi"}))
+
+        here = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tests")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = {}
+        for ident in ("ha-a", "ha-b"):
+            cmd = [sys.executable,
+                   os.path.join(here, "ha_scheduler_proc.py"),
+                   "--server", server.address, "--identity", ident,
+                   "--period", "0.2", "--lease", str(LEASE),
+                   "--renew", "0.75", "--retry", "0.25", "--report"]
+            if not warm:
+                cmd.append("--cold-standby")
+            procs[ident] = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT)
+
+        def submit(s):
+            for j in range(JOBS):
+                name = f"w{s}-j{j}"
+                pg = build_pod_group(name, "bench", min_member=TPJ,
+                                     queue="q0")
+                pg.status.phase = PodGroupPhase.PENDING
+                store.create("podgroups", pg)
+                for i in range(TPJ):
+                    store.create("pods", build_pod(
+                        "bench", f"{name}-{i}", "", "Pending",
+                        {"cpu": "1", "memory": "1Gi"}, name))
+
+        def retire(s):
+            for j in range(JOBS):
+                name = f"w{s}-j{j}"
+                for i in range(TPJ):
+                    try:
+                        store.delete("pods", f"{name}-{i}", "bench")
+                    except NotFoundError:
+                        pass
+                try:
+                    store.delete("podgroups", name, "bench")
+                except NotFoundError:
+                    pass
+
+        def bound(s):
+            return all(
+                (p := store.try_get("pods", f"w{s}-j{j}-{i}", "bench"))
+                is not None and p.node_name
+                for j in range(JOBS) for i in range(TPJ))
+
+        def wait_for(cond, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.02)
+            return cond()
+
+        try:
+            for s in range(WARMUP_WAVES):
+                if s > 0:
+                    retire(s - 1)
+                submit(s)
+                if not wait_for(lambda: bound(s), 180):
+                    return {"error": f"warmup wave {s} never bound"}
+            # kill the leader while a fresh wave is in flight
+            retire(WARMUP_WAVES - 1)
+            lease = store.get("leases", "volcano")
+            victim = lease.holder_identity
+            expiry_at = lease.renew_time + lease.lease_duration_seconds
+            survivor = next(i for i in procs if i != victim)
+            s = WARMUP_WAVES
+            submit(s)
+            t_kill = time.time()
+            procs[victim].kill()
+            if not wait_for(lambda: bound(s), 240):
+                return {"error": "post-kill wave never bound",
+                        "victim": victim}
+            first_bind = min(t for t, _, _ in binds if t > t_kill)
+            # the survivor writes its report AFTER run_once returns;
+            # the binds land DURING it — wait the report out
+            wait_for(lambda: store.try_get(
+                "configmaps", f"report-{survivor}", "default") is not None,
+                30)
+            report = store.try_get("configmaps", f"report-{survivor}",
+                                   "default")
+            timing = json.loads(report.data["timing"]) if report else {}
+            return {
+                "victim": victim,
+                "survivor": survivor,
+                "takeover_from_kill_s": round(first_bind - t_kill, 3),
+                "takeover_from_expiry_s": round(
+                    first_bind - expiry_at, 3),
+                "first_cycle_compiles": timing.get(
+                    "first_cycle_compiles", -1.0),
+                "first_cycle_solve_ms": round(float(timing.get(
+                    "first_cycle_solve_ms", -1.0)), 2),
+                "first_cycle_total_ms": round(float(timing.get(
+                    "first_cycle_total_ms", -1.0)), 2),
+            }
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            server.stop()
+
+    warm = run(warm=True)
+    cold = run(warm=False)
+    ok = ("error" not in warm and "error" not in cold
+          and warm["takeover_from_expiry_s"] < LEASE
+          and warm["first_cycle_compiles"] == 0.0
+          # the cold control proves the compile counter is live: without
+          # shadow cycles the first takeover cycle MUST compile
+          and cold["first_cycle_compiles"] > 0.0)
+    return {
+        "lease_duration_s": LEASE,
+        "warm": warm,
+        "cold": cold,
+        # the acceptance line: takeover within one lease duration of
+        # expiry, and the warm standby's first cycle compiled NOTHING
+        "ok": bool(ok),
+    }
+
+
 def sim_quality():
     """Scheduling-quality A/B on the trace-driven simulator (PR-4
     acceptance config): the SAME seeded workload — >=500 virtual cycles,
@@ -1274,6 +1444,7 @@ def _main_inner() -> dict:
         ("full_cycle_10k_2k", full_cycle),
         ("steady_churn_1p5k_400", steady_churn),
         ("chaos_churn_50", chaos_churn),
+        ("failover_ha", failover),
         ("sim_quality_500c", sim_quality),
     ):
         configs[name] = _run_config(name, fn)

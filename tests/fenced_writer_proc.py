@@ -1,0 +1,85 @@
+"""Subprocess entry for the two-process lease-fencing test.
+
+Flow (driven by tests/test_failover.py::TestFencedDeposedLeader):
+
+1. connect to the networked store, acquire the test lease, CAPTURE the
+   fencing token of this acquisition;
+2. perform one fenced warm-up write (positive control) and print
+   ``WARMUP ok``;
+3. idle until SIGUSR1: the driver SIGSTOPs this process past lease
+   expiry (a GC pause / live-migration stall in production clothing)
+   while a second elector takes the lease, then SIGCONT + SIGUSR1;
+4. on SIGUSR1, attempt the late commit — a bind-shaped pod update —
+   with the token captured in (1). The store must refuse it with
+   FencedError: print ``FENCED`` and exit 42. If the write lands, print
+   ``SPLIT-BRAIN`` and exit 1.
+
+Deliberately imports no jax/scheduler modules so the subprocess starts
+fast enough for a tier-1 test.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--identity", required=True)
+    ap.add_argument("--lease", type=float, default=1.0)
+    args = ap.parse_args()
+
+    resumed = {"go": False}
+    signal.signal(signal.SIGUSR1,
+                  lambda *_a: resumed.__setitem__("go", True))
+
+    from volcano_tpu.client import FencedError, RemoteClusterStore
+    from volcano_tpu.utils.leader_election import LeaderElector, LeaseLock
+
+    remote = RemoteClusterStore(args.server)
+    elector = LeaderElector(LeaseLock(remote, "fence-test"),
+                            identity=args.identity,
+                            lease_duration=args.lease,
+                            retry_period=args.lease / 4)
+    deadline = time.time() + 30
+    while not elector.step():
+        if time.time() > deadline:
+            print("NEVER-LED", flush=True)
+            return 2
+        time.sleep(0.05)
+    token = elector.fencing_token()  # captured at acquisition
+
+    # positive control: a fenced write from the live leader must land
+    warm = remote.get("pods", "warmup", "d")
+    warm.phase = "Running"
+    remote.update("pods", warm, fencing=token)
+    print("WARMUP ok", flush=True)
+
+    deadline = time.time() + 60
+    while not resumed["go"]:
+        if time.time() > deadline:
+            print("NEVER-RESUMED", flush=True)
+            return 3
+        time.sleep(0.02)
+
+    # the late commit: bind the victim with the PRE-PAUSE token
+    try:
+        victim = remote.get("pods", "victim", "d")
+        victim.node_name = "n-old-leader"
+        victim.phase = "Running"
+        remote.update("pods", victim, fencing=token)
+    except FencedError as e:
+        print(f"FENCED {e}", flush=True)
+        return 42
+    print("SPLIT-BRAIN", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,14 +1,33 @@
-"""Subprocess entry for the cross-process HA test: one scheduler process
-attached to a networked ClusterStore, running under leader election.
+"""Subprocess entry for the cross-process HA tests: one scheduler process
+attached to a networked ClusterStore, running under leader election with
+the full crash-safe ladder (fencing, bind-intent journal, takeover
+recovery, warm standby).
 
 Usage: python ha_scheduler_proc.py --server HOST:PORT --identity NAME
-The process runs until killed; the test SIGKILLs the leader mid-flight and
-asserts the standby takes over (reference
-cmd/scheduler/app/server.go:85-118: two processes contending on one
-resourcelock at the API server).
+The process runs until killed; the tests SIGKILL the leader mid-flight
+(or arm a fault point that crashes it at an exact seam) and assert the
+standby takes over (reference cmd/scheduler/app/server.go:85-118: two
+processes contending on one resourcelock at the API server).
+
+Chaos/bench hooks:
+
+- ``--lease/--renew/--retry`` shrink the lease contract so tests fail
+  over in seconds;
+- ``$VOLCANO_FAULTS`` (or ``--faults``) arms the deterministic fault
+  injector at start; ``exc:exit`` specs crash the process AT the seam;
+- a ``configmaps`` object named ``faults-<identity>`` re-arms the
+  injector live (``data={"spec": ...}``) — the kill-the-leader soak
+  targets the CURRENT leader without restarting it;
+- ``--report`` writes a ``report-<identity>`` configmap after every
+  scheduling cycle carrying cycle count + last_cycle_timing (compile
+  counts included), which is how the failover bench reads takeover
+  latency and first-cycle-after-takeover solve/compile numbers;
+- ``--cold-standby`` disables the warm-standby shadow cycles (the A/B
+  the failover bench measures).
 """
 
 import argparse
+import json
 import os
 import sys
 import threading
@@ -23,11 +42,34 @@ def main() -> int:
     ap.add_argument("--server", required=True)
     ap.add_argument("--identity", required=True)
     ap.add_argument("--period", type=float, default=0.2)
+    ap.add_argument("--lease", type=float, default=2.0)
+    ap.add_argument("--renew", type=float, default=1.5)
+    ap.add_argument("--retry", type=float, default=0.5)
+    ap.add_argument("--conf", default=None,
+                    help="scheduler conf YAML path")
+    ap.add_argument("--faults", default=None,
+                    help="fault spec applied at start (same grammar as "
+                         "$VOLCANO_FAULTS)")
+    ap.add_argument("--report", action="store_true",
+                    help="write a report-<identity> configmap per cycle")
+    ap.add_argument("--cold-standby", action="store_true",
+                    help="disable warm-standby shadow cycles")
     args = ap.parse_args()
 
     from volcano_tpu.cache import SchedulerCache
     from volcano_tpu.client import RemoteClusterStore
+    from volcano_tpu.models import ConfigMap
+    from volcano_tpu.resilience import faults
     from volcano_tpu.scheduler import Scheduler
+
+    if args.faults:
+        faults.configure(args.faults)
+
+    # compile accounting must be live so the failover bench can assert
+    # "zero session-thread compiles in the first post-takeover cycle"
+    # from volcano_solver_compile_* rather than infer it from latency
+    from volcano_tpu.ops.precompile import watcher
+    watcher.install()
 
     # A broken watch stream first resumes in place (reconnect + journal
     # replay from the rv high-water mark — a store-server restart is a
@@ -37,13 +79,70 @@ def main() -> int:
     remote = RemoteClusterStore(
         args.server, on_watch_failure=lambda: os._exit(3))
     cache = SchedulerCache(remote)
-    sched = Scheduler(cache, period=args.period)
+
+    conf = None
+    if args.conf:
+        with open(args.conf) as f:
+            conf = f.read()
+
+    cycles = {"n": 0}
+
+    first_cycle = {}
+
+    class ReportingScheduler(Scheduler):
+        """Publishes per-cycle timing to the store so the driver process
+        can read takeover latency and compile counts without IPC. The
+        FIRST leader cycle's solve/compile numbers are pinned into every
+        report — that cycle is exactly what the warm-vs-cold standby A/B
+        measures, and pinning makes the read race-free."""
+
+        def run_once(self):
+            super().run_once()
+            cycles["n"] += 1
+            if cycles["n"] == 1:
+                t = self.last_cycle_timing
+                first_cycle.update({
+                    "first_cycle_compiles": t.get("session_compiles", 0.0),
+                    "first_cycle_solve_ms": t.get("solve_ms", 0.0),
+                    "first_cycle_total_ms": t.get("total_ms", 0.0),
+                })
+            if args.report:
+                try:
+                    remote.apply("configmaps", ConfigMap(
+                        name=f"report-{args.identity}",
+                        data={"cycle": str(cycles["n"]),
+                              "timing": json.dumps(
+                                  {**self.last_cycle_timing,
+                                   **first_cycle})}))
+                except Exception:  # noqa: BLE001 — diagnostics only
+                    pass
+
+    sched = ReportingScheduler(cache, scheduler_conf=conf,
+                               period=args.period)
+
+    # live fault re-arming: the driver writes faults-<identity> to crash
+    # THIS process at a chosen seam while it leads
+    def on_faults_cm(event, cm, old):
+        if event == "delete" or cm.name != f"faults-{args.identity}":
+            return
+        spec = (cm.data or {}).get("spec", "")
+        if spec:
+            try:
+                faults.configure(spec)
+                print(f"ha-scheduler {args.identity} armed: {spec}",
+                      flush=True)
+            except ValueError:
+                pass
+
+    remote.watch("configmaps", on_faults_cm)
+
     print(f"ha-scheduler {args.identity} up", flush=True)
     stop = threading.Event()
-    # short lease so the test fails over in seconds, not 15s
     sched.run_with_leader_election(
         stop, identity=args.identity,
-        lease_duration=2.0, renew_deadline=1.5, retry_period=0.5)
+        lease_duration=args.lease, renew_deadline=args.renew,
+        retry_period=args.retry,
+        warm_standby=not args.cold_standby)
     return 0
 
 

@@ -161,6 +161,104 @@ class TestJobController:
         assert envs["VC_TASK_INDEX"] == "1"
 
 
+class TestJobRetryBackoff:
+    """A failing sync re-enqueues with capped exponential backoff +
+    jitter per job key (reference maxRetry), never immediately and never
+    unbounded."""
+
+    def _controller_with_failing_sync(self, fail_times):
+        from volcano_tpu.controllers.job.controller import JobController
+
+        store, cm = make_world()
+        jc = next(c for c in cm.controllers
+                  if isinstance(c, JobController))
+        clock = {"t": 1000.0}
+        jc.clock = lambda: clock["t"]
+        jc.retry_rng = __import__("random").Random(7)
+        attempts = []
+        orig = jc._process
+
+        def flaky(req):
+            attempts.append(jc.clock())
+            if len(attempts) <= fail_times:
+                raise RuntimeError("sync blew up")
+            return orig(req)
+
+        jc._process = flaky
+        return store, cm, jc, clock, attempts
+
+    def test_backoff_is_delayed_capped_and_counted(self):
+        from volcano_tpu.controllers.job.controller import (
+            MAX_RETRIES, RETRY_BASE_S,
+        )
+        from volcano_tpu.metrics import metrics
+
+        store, cm, jc, clock, attempts = \
+            self._controller_with_failing_sync(fail_times=3)
+        store.create("jobs", simple_job())
+        key = "default/job1"
+        before = metrics.job_retry_total.get(labels={"job_id": key})
+
+        jc.process_all()
+        assert len(attempts) == 1       # failed once, NOT retried inline
+        assert len(jc._deferred) == 1   # re-enqueued with a delay
+        not_before, _ = jc._deferred[0]
+        delay1 = not_before - clock["t"]
+        # base * jitter in [0.5, 1.5)
+        assert RETRY_BASE_S * 0.5 <= delay1 < RETRY_BASE_S * 1.5
+        assert metrics.job_retry_total.get(
+            labels={"job_id": key}) == before + 1
+
+        jc.process_all()                # delay not elapsed: nothing runs
+        assert len(attempts) == 1
+
+        clock["t"] += delay1 + 0.001    # due: retry 2 fails, backs off 2x
+        jc.process_all()
+        assert len(attempts) == 2
+        delay2 = jc._deferred[0][0] - clock["t"]
+        assert RETRY_BASE_S * 2 * 0.5 <= delay2 < RETRY_BASE_S * 2 * 1.5
+
+        clock["t"] += delay2 + 0.001    # retry 3 fails
+        jc.process_all()
+        clock["t"] += 10                # retry 4 SUCCEEDS
+        jc.process_all()
+        assert len(attempts) == 4
+        assert jc._retry_counts.get(key) is None  # success resets budget
+        assert metrics.job_retry_total.get(
+            labels={"job_id": key}) == before + 3
+        # the successful sync did its job
+        assert store.try_get("podgroups", "job1", "default") is not None
+        assert MAX_RETRIES == 15  # reference maxRetry
+
+    def test_gives_up_after_max_retries(self):
+        from volcano_tpu.controllers.job.controller import (
+            MAX_RETRIES, RETRY_CAP_S,
+        )
+
+        store, cm, jc, clock, attempts = \
+            self._controller_with_failing_sync(fail_times=10 ** 9)
+        store.create("jobs", simple_job())
+        for _ in range(MAX_RETRIES + 5):
+            jc.process_all()
+            clock["t"] += RETRY_CAP_S * 2  # every pending retry comes due
+        # initial attempt + MAX_RETRIES re-enqueues, then dropped
+        assert len(attempts) == MAX_RETRIES + 1
+        assert jc._deferred == []
+
+    def test_backoff_delay_is_capped(self):
+        from volcano_tpu.controllers.job.controller import RETRY_CAP_S
+
+        store, cm, jc, clock, attempts = \
+            self._controller_with_failing_sync(fail_times=10 ** 9)
+        store.create("jobs", simple_job())
+        for _ in range(12):  # enough failures to exceed the cap
+            jc.process_all()
+            if jc._deferred:
+                delay = jc._deferred[0][0] - clock["t"]
+                assert delay < RETRY_CAP_S * 1.5
+            clock["t"] += RETRY_CAP_S * 2
+
+
 class TestQueueController:
     def test_queue_status_counts_and_close(self):
         store, cm = make_world()

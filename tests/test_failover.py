@@ -1,0 +1,768 @@
+"""Crash-safe warm restart: lease fencing, the bind-intent journal,
+takeover recovery, warm-standby shadow cycles, and the kill-the-leader
+chaos soak.
+
+Tier-1 (fast) coverage: fencing semantics at the store, the journal's
+record/sweep lifecycle, a single-process failover smoke (leader crashes
+mid-dispatch, standby recovers bind-for-bind against a golden run), the
+write-free shadow cycle, LeaderElector.step edge cases, and the
+two-process deposed-leader FencedError proof. The 50-cycle multi-process
+kill-the-leader soak is marked slow; `bench.py failover` records the
+takeover-latency / warm-vs-cold numbers."""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from helpers import build_node, build_pod, build_pod_group, build_queue
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import (
+    ClusterStore, FencedError, FencedStore, RemoteClusterStore, StoreServer,
+)
+from volcano_tpu.client.codec import encode
+from volcano_tpu.metrics import metrics
+from volcano_tpu.models import PodGroupPhase
+from volcano_tpu.resilience import (
+    BindIntentJournal, faults, reconcile_bind_intents,
+)
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.utils.leader_election import LeaderElector, LeaseLock
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _build_cluster(store=None, n_nodes=4, n_jobs=2, tpj=2):
+    store = store if store is not None else ClusterStore()
+    store.apply("queues", build_queue("q0", weight=1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(f"n{i}",
+                                         {"cpu": "16", "memory": "64Gi"}))
+    for k in range(n_jobs):
+        pg = build_pod_group(f"j{k}", "t", min_member=tpj, queue="q0")
+        pg.status.phase = PodGroupPhase.PENDING
+        store.create("podgroups", pg)
+        for i in range(tpj):
+            store.create("pods", build_pod(
+                "t", f"j{k}-{i}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}, f"j{k}"))
+    return store
+
+
+def _binds(store):
+    return {p.name: p.node_name for p in store.list("pods", namespace="t")}
+
+
+HOST_CONF = ('actions: "enqueue, allocate"\n'
+             'tiers:\n- plugins:\n  - name: gang\n'
+             '  - name: predicates\n  - name: nodeorder\n'
+             'configurations:\n- name: allocate\n'
+             '  arguments: {mode: host}\n')
+
+
+# ---------------------------------------------------------------------------
+# lease fencing at the store
+# ---------------------------------------------------------------------------
+
+class TestFencing:
+    def _leased_store(self):
+        clock = FakeClock()
+        store = ClusterStore()
+        store.clock = clock
+        elector = LeaderElector(LeaseLock(store, "volcano"), identity="A",
+                                lease_duration=10.0, clock=clock)
+        assert elector.step()
+        return store, elector, clock
+
+    def test_valid_token_writes_stale_holder_fenced(self):
+        store, ea, clock = self._leased_store()
+        store.create("pods", build_pod("d", "p", "", "Pending",
+                                       {"cpu": "1"}, "pg"))
+        pod = store.get("pods", "p", "d")
+        store.update("pods", pod, fencing=ea.fencing_token())  # leader: ok
+
+        # B takes the lease after expiry: A's token goes stale
+        clock.t += 11
+        eb = LeaderElector(LeaseLock(store, "volcano"), identity="B",
+                           lease_duration=10.0, clock=clock)
+        assert eb.step()
+        before = metrics.fenced_writes_total.get(labels={"holder": "A"})
+        with pytest.raises(FencedError):
+            store.update("pods", pod, fencing=ea.fencing_token() or
+                         {"lock": "volcano", "holder": "A", "epoch": 1})
+        assert metrics.fenced_writes_total.get(
+            labels={"holder": "A"}) == before + 1
+        store.update("pods", pod, fencing=eb.fencing_token())  # B: ok
+
+    def test_epoch_stale_after_reacquisition_by_other(self):
+        """Same holder, older acquisition epoch: the token must not
+        survive an intervening leadership transition."""
+        store, ea, clock = self._leased_store()
+        token_a1 = ea.fencing_token()
+        clock.t += 11
+        eb = LeaderElector(LeaseLock(store, "volcano"), identity="B",
+                           lease_duration=10.0, clock=clock)
+        assert eb.step()
+        clock.t += 11
+        ea.step()         # first step observes the blown renew deadline
+        assert ea.step()  # A re-acquires: epoch bumped twice since a1
+        assert ea.fencing_token()["epoch"] != token_a1["epoch"]
+        store.create("pods", build_pod("d", "p", "", "Pending",
+                                       {"cpu": "1"}, "pg"))
+        pod = store.get("pods", "p", "d")
+        with pytest.raises(FencedError):
+            store.update("pods", pod, fencing=token_a1)
+        store.update("pods", pod, fencing=ea.fencing_token())
+
+    def test_expired_lease_fences_even_without_takeover(self):
+        """A paused leader past expiry must not commit even when no
+        standby has taken the lease yet — the store's clock arbitrates."""
+        store, ea, clock = self._leased_store()
+        token = ea.fencing_token()
+        store.create("pods", build_pod("d", "p", "", "Pending",
+                                       {"cpu": "1"}, "pg"))
+        pod = store.get("pods", "p", "d")
+        clock.t += 10.5  # expired; nobody else acquired
+        with pytest.raises(FencedError):
+            store.update("pods", pod, fencing=token)
+
+    def test_fenced_store_fails_closed_without_a_lease(self):
+        store = ClusterStore()
+        fenced = FencedStore(store, lambda: None)
+        with pytest.raises(FencedError):
+            fenced.create("pods", build_pod("d", "p", "", "Pending",
+                                            {"cpu": "1"}, "pg"))
+        assert store.list("pods") == []
+        # reads pass through unfenced
+        assert fenced.try_get("pods", "p", "d") is None
+
+    def test_fencing_travels_the_wire(self):
+        """RemoteClusterStore carries the token; the SERVER's lease
+        record arbitrates (the deposed client's view is untrusted)."""
+        store = ClusterStore()
+        clock = FakeClock()
+        store.clock = clock
+        server = StoreServer(store).start()
+        remote = RemoteClusterStore(server.address)
+        try:
+            ea = LeaderElector(LeaseLock(remote, "volcano"), identity="A",
+                               lease_duration=10.0, clock=clock)
+            assert ea.step()
+            remote.create("pods", build_pod("d", "p", "", "Pending",
+                                            {"cpu": "1"}, "pg"),
+                          fencing=ea.fencing_token())
+            clock.t += 11
+            eb = LeaderElector(LeaseLock(remote, "volcano"), identity="B",
+                               lease_duration=10.0, clock=clock)
+            assert eb.step()
+            pod = remote.get("pods", "p", "d")
+            with pytest.raises(FencedError):
+                remote.update("pods", pod, fencing={
+                    "lock": "volcano", "holder": "A", "epoch": 1})
+        finally:
+            remote.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# bind-intent journal lifecycle
+# ---------------------------------------------------------------------------
+
+class TestBindIntentJournal:
+    def test_record_then_sweep_confirms_once_bindings_visible(
+            self, monkeypatch):
+        # disable the age-based fallback so this test isolates the
+        # settled-in-store confirmation rule
+        from volcano_tpu.resilience import recovery
+        monkeypatch.setattr(recovery, "SWEEP_GENERATIONS", 10 ** 6)
+
+        store = _build_cluster()
+        cache = SchedulerCache(store)
+        cache.run()
+        job = cache.jobs["t/j0"]
+        tasks = list(job.tasks.values())
+        for i, t in enumerate(tasks):
+            t.node_name = f"n{i}"
+        journal = BindIntentJournal(store, identity="A")
+        intent = journal.record(tasks)
+        assert store.get("bindintents", intent.name).bindings == [
+            ["t", t.name, t.node_name] for t in tasks]
+
+        # pods still unbound in the store: sweeps keep it
+        assert journal.sweep() == 0
+        assert journal.sweep() == 0
+        assert store.try_get("bindintents", intent.name) is not None
+
+        # binds land -> the next sweep confirms (deletes) it
+        for t in tasks:
+            pod = store.get("pods", t.name, "t")
+            pod.node_name = t.node_name
+            store.update("pods", pod)
+        assert journal.sweep() == 1
+        assert store.try_get("bindintents", intent.name) is None
+
+    def test_stale_unsettled_intent_swept_after_two_generations(self):
+        store = _build_cluster()
+        cache = SchedulerCache(store)
+        cache.run()
+        tasks = list(cache.jobs["t/j0"].tasks.values())
+        for t in tasks:
+            t.node_name = "n0"
+        journal = BindIntentJournal(store, identity="A")
+        intent = journal.record(tasks)
+        journal.sweep()          # gen 1: kept (unsettled, young)
+        assert journal.sweep() == 1  # gen 2: presumed rolled back
+        assert store.try_get("bindintents", intent.name) is None
+
+
+# ---------------------------------------------------------------------------
+# single-process failover smoke (tier-1): crash mid-dispatch, recover
+# ---------------------------------------------------------------------------
+
+class TestFailoverSmoke:
+    def _golden(self):
+        store = _build_cluster()
+        cache = SchedulerCache(store)
+        cache.run()
+        Scheduler(cache, scheduler_conf=HOST_CONF).run_once()
+        return _binds(store)
+
+    def test_mid_dispatch_crash_recovers_bind_for_bind(self):
+        golden = self._golden()
+
+        clock = FakeClock()
+        store = ClusterStore()
+        store.clock = clock
+        _build_cluster(store)
+
+        # audit: count node-setting pod updates so duplicates are visible
+        bind_writes = []
+
+        def audit(verb, kind, obj):
+            if kind == "pods" and verb == "update" and obj.node_name:
+                prev = store.try_get("pods", obj.name, obj.namespace)
+                if prev is None or prev is obj or not prev.node_name:
+                    bind_writes.append(obj.name)
+            return obj
+
+        store.add_interceptor(audit)
+
+        # leader A: fencing + journal installed as run_with_leader_election
+        # would; crash simulated at the SECOND statement commit, i.e. j0's
+        # binds land, j1 is journaled but never dispatched
+        cache_a = SchedulerCache(store)
+        cache_a.run()
+        ea = LeaderElector(LeaseLock(store, "volcano"), identity="A",
+                           lease_duration=10.0, clock=clock)
+        assert ea.step()
+        cache_a.install_fencing(ea.fencing_token)
+        cache_a.bind_journal = BindIntentJournal(
+            cache_a.fenced_cluster, identity="A", clock=clock)
+        sched_a = Scheduler(cache_a, scheduler_conf=HOST_CONF)
+        faults.arm("bind_commit", at=(2,))
+        sched_a.run_once()  # FaultError at j1's commit is contained
+        faults.reset()
+        partial = _binds(store)
+        assert sorted(v for v in partial.values() if v), \
+            "the first statement's binds must have landed"
+        assert not all(partial.values()), "j1 must be caught mid-dispatch"
+        assert len(store.list("bindintents")) >= 1
+
+        # A "crashes"; past lease expiry, standby B takes over
+        clock.t += 11
+        eb = LeaderElector(LeaseLock(store, "volcano"), identity="B",
+                           lease_duration=10.0, clock=clock)
+        assert eb.step()
+        cache_b = SchedulerCache(store)
+        cache_b.run()
+        cache_b.install_fencing(eb.fencing_token)
+        summary = reconcile_bind_intents(store, eb.fencing_token)
+        assert summary["redriven"] >= 1 and summary["lost"] == 0
+
+        # zero lost, zero duplicate, identical to the uninterrupted run
+        assert _binds(store) == golden
+        assert sorted(bind_writes) == sorted(golden)  # each pod bound once
+        assert store.list("bindintents") == []
+
+        # the deposed leader's late commit is fenced, byte-for-byte no-op
+        victim = store.get("pods", "j0-0", "t")
+        before = json.dumps(encode(victim), sort_keys=True)
+        with pytest.raises(FencedError):
+            cache_a.fenced_cluster.update("pods", victim)
+        assert json.dumps(encode(store.get("pods", "j0-0", "t")),
+                          sort_keys=True) == before
+
+        # B's first real cycle finds nothing left to place
+        sched_b = Scheduler(cache_b, scheduler_conf=HOST_CONF)
+        cache_b.bind_journal = BindIntentJournal(
+            cache_b.fenced_cluster, identity="B", clock=clock)
+        marks = len(bind_writes)
+        sched_b.run_once()
+        assert len(bind_writes) == marks
+        assert _binds(store) == golden
+
+    def test_pre_commit_crash_reschedules_identically(self):
+        """Crash BEFORE any effect (bind_commit at:1): the intent is
+        durable but nothing applied — recovery re-drives the whole wave
+        to exactly the crashed leader's decision."""
+        golden = self._golden()
+        clock = FakeClock()
+        store = ClusterStore()
+        store.clock = clock
+        _build_cluster(store)
+        cache_a = SchedulerCache(store)
+        cache_a.run()
+        ea = LeaderElector(LeaseLock(store, "volcano"), identity="A",
+                           lease_duration=10.0, clock=clock)
+        assert ea.step()
+        cache_a.install_fencing(ea.fencing_token)
+        cache_a.bind_journal = BindIntentJournal(
+            cache_a.fenced_cluster, identity="A", clock=clock)
+        faults.arm("bind_commit", at=(1,))
+        Scheduler(cache_a, scheduler_conf=HOST_CONF).run_once()
+        faults.reset()
+        assert not any(_binds(store).values())  # nothing dispatched
+        assert len(store.list("bindintents")) == 1  # j0 journaled only
+
+        clock.t += 11
+        eb = LeaderElector(LeaseLock(store, "volcano"), identity="B",
+                           lease_duration=10.0, clock=clock)
+        assert eb.step()
+        summary = reconcile_bind_intents(store, eb.fencing_token)
+        # j0's whole gang re-driven exactly as the dead leader decided
+        assert summary["redriven"] == 2 and summary["adopted"] == 0
+        # j1 (decided nothing before the crash) schedules fresh — and
+        # deterministically lands where the uninterrupted run put it
+        cache_b = SchedulerCache(store)
+        cache_b.run()
+        cache_b.install_fencing(eb.fencing_token)
+        Scheduler(cache_b, scheduler_conf=HOST_CONF).run_once()
+        assert _binds(store) == golden
+
+
+# ---------------------------------------------------------------------------
+# warm standby: the write-free shadow cycle
+# ---------------------------------------------------------------------------
+
+class TestShadowCycle:
+    def test_shadow_cycle_is_write_free_and_mirror_safe(self):
+        golden = self._golden_solver()
+        store = _build_cluster()
+        cache = SchedulerCache(store)
+        cache.run()
+        sched = Scheduler(cache)
+        rv_before = store._rv
+        phases = {pg.name: pg.status.phase
+                  for pg in store.list("podgroups")}
+        sched.shadow_cycle()
+        # no store writes, no binds, podgroup phases untouched
+        assert store._rv == rv_before
+        assert not any(_binds(store).values())
+        assert phases == {pg.name: pg.status.phase
+                          for pg in store.list("podgroups")}
+        # mirror node accounting fully unwound
+        assert all(not n.tasks and n.used.milli_cpu == 0
+                   for n in cache.nodes.values())
+        # and the real cycle afterwards schedules exactly like a cold run
+        sched.run_once()
+        assert _binds(store) == golden
+
+    def _golden_solver(self):
+        store = _build_cluster()
+        cache = SchedulerCache(store)
+        cache.run()
+        Scheduler(cache).run_once()
+        return _binds(store)
+
+    def test_standby_loop_runs_shadows_and_leader_cycles(self):
+        """run_with_leader_election end-to-end: a standby shadows without
+        writing; once the leader releases, takeover recovers + binds."""
+        import threading
+
+        store = _build_cluster()
+        other = LeaderElector(LeaseLock(store, "volcano"),
+                              identity="other", lease_duration=1.0,
+                              retry_period=0.1)
+        assert other.step()
+
+        cache = SchedulerCache(store)
+        sched = Scheduler(cache, scheduler_conf=HOST_CONF, period=0.01)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=sched.run_with_leader_election, args=(stop,),
+            kwargs={"lease_duration": 1.0, "renew_deadline": 0.75,
+                    "retry_period": 0.1}, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        other.step()  # keep the lease while the standby shadows
+        assert not any(_binds(store).values())  # standby never wrote
+
+        other.release()
+        deadline = time.time() + 30
+        while not all(_binds(store).values()) and time.time() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=10)
+        assert all(_binds(store).values())
+        assert store.list("bindintents") == []  # swept after confirm
+
+
+# ---------------------------------------------------------------------------
+# LeaderElector.step edge cases
+# ---------------------------------------------------------------------------
+
+class TestLeaderElectorEdges:
+    def test_lease_stolen_between_read_and_renew(self):
+        """A reads the lease, B commits first: A's CAS write loses with
+        ConflictError and A steps down instead of split-braining."""
+        import copy
+
+        clock = FakeClock()
+        store = ClusterStore()
+        lost = []
+        stolen = {"armed": False}
+
+        class RacingLock(LeaseLock):
+            def get(self):
+                lease = super().get()
+                if stolen["armed"]:
+                    stolen["armed"] = False
+                    fresh = copy.copy(self.store.get("leases", self.name))
+                    fresh.holder_identity = "B"
+                    fresh.lease_transitions += 1
+                    fresh.renew_time = clock()
+                    self.store.update("leases", fresh)
+                return lease
+
+        ea = LeaderElector(RacingLock(store, "volcano"), identity="A",
+                           lease_duration=10.0, retry_period=1.0,
+                           on_stopped_leading=lambda: lost.append(1),
+                           clock=clock)
+        assert ea.step() and ea.is_leader
+        clock.t += 2.0            # past retry_period: A will re-write
+        stolen["armed"] = True    # B commits between A's read and write
+        assert ea.step() is False
+        assert not ea.is_leader and lost == [1]
+        assert ea.fencing_token() is None  # fenced writes now fail closed
+        assert store.get("leases", "volcano").holder_identity == "B"
+
+    def test_clock_skew_past_renew_deadline_steps_down(self):
+        clock = FakeClock()
+        store = ClusterStore()
+        lost, led = [], []
+        ea = LeaderElector(LeaseLock(store, "volcano"), identity="A",
+                           lease_duration=30.0, renew_deadline=10.0,
+                           retry_period=1.0,
+                           on_started_leading=lambda: led.append(1),
+                           on_stopped_leading=lambda: lost.append(1),
+                           clock=clock)
+        assert ea.step()
+        epoch = ea.fence_epoch
+        clock.t += 10.5  # mid-renewal skew beyond RENEW_DEADLINE
+        assert ea.step() is False  # steps down: the lease may be gone
+        assert lost == [1] and not ea.is_leader
+        # holder unchanged, so the NEXT step re-acquires without a
+        # transition bump — same fencing epoch, leadership regained
+        assert ea.step() and ea.is_leader
+        assert ea.fence_epoch == epoch and led == [1, 1]
+
+    def test_two_racing_first_acquirers_both_take_create_path(self):
+        """Both observe an absent lease; both must go through CREATE so
+        the store serializes them — the loser conflicts instead of
+        overwriting via the version-0 update bypass."""
+        clock = FakeClock()
+        store = ClusterStore()
+        creates = []
+
+        class ObservedLock(LeaseLock):
+            def __init__(self, store, name, stale_reads):
+                super().__init__(store, name)
+                self.stale_reads = stale_reads
+
+            def get(self):
+                if self.stale_reads:
+                    self.stale_reads.pop()
+                    return None  # read BEFORE the rival's create landed
+                return super().get()
+
+            def create_or_update(self, lease):
+                if not lease.resource_version:
+                    creates.append(self.name)
+                return super().create_or_update(lease)
+
+        ea = LeaderElector(ObservedLock(store, "volcano", []),
+                           identity="A", clock=clock)
+        eb = LeaderElector(ObservedLock(store, "volcano", [1]),
+                           identity="B", clock=clock)
+        assert ea.step()           # A creates first
+        assert eb.step() is False  # B raced: stale read -> create -> lose
+        assert creates == ["volcano", "volcano"]  # BOTH took create
+        assert ea.is_leader and not eb.is_leader
+        lease = store.get("leases", "volcano")
+        assert lease.holder_identity == "A"
+        assert lease.lease_transitions == 1  # B's loss never wrote
+
+
+# ---------------------------------------------------------------------------
+# two-process: the paused deposed leader's late commit is fenced
+# ---------------------------------------------------------------------------
+
+class TestFencedDeposedLeader:
+    def test_paused_leader_late_commit_rejected_byte_for_byte(self):
+        from volcano_tpu.models import Pod
+
+        store = ClusterStore()
+        server = StoreServer(store).start()
+        store.create("pods", Pod(name="warmup", namespace="d"))
+        store.create("pods", Pod(name="victim", namespace="d"))
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(here, "fenced_writer_proc.py"),
+             "--server", server.address, "--identity", "old-leader",
+             "--lease", "1.0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            bufsize=1)
+        try:
+            # wait for the positive control (a fenced write that LANDS)
+            line = ""
+            deadline = time.time() + 60
+            while "WARMUP ok" not in line:
+                assert time.time() < deadline, f"no warmup: {line!r}"
+                line = proc.stdout.readline()
+            assert store.get("pods", "warmup", "d").phase == "Running"
+
+            os.kill(proc.pid, signal.SIGSTOP)  # the GC-pause stand-in
+            try:
+                time.sleep(1.6)  # > lease: the old leader is expired
+                eb = LeaderElector(LeaseLock(store, "fence-test"),
+                                   identity="new-leader",
+                                   lease_duration=5.0)
+                deadline = time.time() + 10
+                while not eb.step():
+                    assert time.time() < deadline, "takeover never happened"
+                    time.sleep(0.1)
+                victim_before = json.dumps(
+                    encode(store.get("pods", "victim", "d")),
+                    sort_keys=True)
+            finally:
+                os.kill(proc.pid, signal.SIGCONT)
+
+            os.kill(proc.pid, signal.SIGUSR1)  # now attempt the late commit
+            out, _ = proc.communicate(timeout=30)
+            assert proc.returncode == 42, f"unexpected: rc="\
+                f"{proc.returncode} out={out!r}"
+            assert "FENCED" in out
+            # byte-for-byte: the late commit changed nothing
+            assert json.dumps(
+                encode(store.get("pods", "victim", "d")),
+                sort_keys=True) == victim_before
+            assert not store.get("pods", "victim", "d").node_name
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# kill-the-leader chaos soak (slow; multi-process, 50 waves)
+# ---------------------------------------------------------------------------
+
+SOAK_WAVES = 50
+SOAK_JOBS, SOAK_TPJ, SOAK_NODES = 3, 2, 6
+
+#: each kill crashes the CURRENT leader at an exact seam (exc:exit ==
+#: SIGKILL landing on that line), covering every acceptance fault point:
+#: pre-commit (before the solve decided anything), post-collect (solve
+#: collected + wave journaled, zero effects applied), mid-dispatch (a
+#: later flush / a mid-stream store write with some binds landed),
+#: lease_renew, and bind_commit itself
+SOAK_KILL_SPECS = [
+    "solver_dispatch=at:1,exc:exit",  # pre-commit
+    "bind_commit=at:1,exc:exit",      # post-collect: intent durable
+    "bind_commit=at:2,exc:exit",      # mid-dispatch (a later flush)
+    "store_request=at:7,exc:exit",    # mid-dispatch (mid store write)
+    "lease_renew=at:3,exc:exit",      # renew seam
+]
+
+
+def _soak_wave_submit(store, s):
+    for j in range(SOAK_JOBS):
+        name = f"w{s}-j{j}"
+        pg = build_pod_group(name, "t", min_member=SOAK_TPJ, queue="q0")
+        pg.status.phase = PodGroupPhase.PENDING
+        store.create("podgroups", pg)
+        for i in range(SOAK_TPJ):
+            store.create("pods", build_pod(
+                "t", f"{name}-{i}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}, name))
+
+
+def _soak_wave_retire(store, s):
+    from volcano_tpu.client.store import NotFoundError
+    for j in range(SOAK_JOBS):
+        name = f"w{s}-j{j}"
+        for i in range(SOAK_TPJ):
+            try:
+                store.delete("pods", f"{name}-{i}", "t")
+            except NotFoundError:
+                pass
+        try:
+            store.delete("podgroups", name, "t")
+        except NotFoundError:
+            pass
+
+
+def _soak_wave_bound(store, s):
+    for j in range(SOAK_JOBS):
+        for i in range(SOAK_TPJ):
+            p = store.try_get("pods", f"w{s}-j{j}-{i}", "t")
+            if p is None or not p.node_name:
+                return False
+    return True
+
+
+@pytest.mark.slow
+class TestKillTheLeaderSoak:
+    """50 waves through a networked control plane under leader election;
+    the leader is crashed at randomized fault seams ~8 times. Zero
+    duplicate binds, zero lost gang members, and the decision trace is
+    identical to an uninterrupted golden run."""
+
+    CONF = ('actions: "enqueue, allocate"\n'
+            'tiers:\n- plugins:\n  - name: gang\n'
+            '  - name: predicates\n  - name: nodeorder\n')
+
+    def _driver(self, tmp_path, kill_schedule, procs_wanted):
+        """Run the wave script; returns (trace lines, duplicate count)."""
+        from volcano_tpu.sim.recorder import DecisionRecorder
+
+        conf_path = tmp_path / "soak.yaml"
+        conf_path.write_text(self.CONF)
+        store = ClusterStore()
+        bind_events = []   # (pod, node) on unbound -> bound transitions
+        dup_binds = []
+
+        def audit(verb, kind, obj):
+            if kind == "pods" and verb == "update" and obj.node_name:
+                prev = store.try_get("pods", obj.name, obj.namespace)
+                if prev is None or prev is obj or not prev.node_name:
+                    if any(p == obj.name for p, _ in bind_events):
+                        dup_binds.append(obj.name)
+                    bind_events.append((obj.name, obj.node_name))
+            return obj
+
+        store.add_interceptor(audit)
+        server = StoreServer(store).start()
+        store.apply("queues", build_queue("q0", weight=1))
+        for i in range(SOAK_NODES):
+            store.create("nodes", build_node(
+                f"n{i}", {"cpu": "16", "memory": "64Gi"}))
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        seq = [0]
+        procs = {}
+
+        def spawn():
+            seq[0] += 1
+            ident = f"s{seq[0]}"
+            procs[ident] = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(here, "ha_scheduler_proc.py"),
+                 "--server", server.address, "--identity", ident,
+                 "--period", "0.15", "--lease", "1.0", "--renew", "0.75",
+                 "--retry", "0.25", "--conf", str(conf_path)],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT)
+            return ident
+
+        for _ in range(procs_wanted):
+            spawn()
+
+        rec = DecisionRecorder(clock=lambda: 0.0)
+        kills_armed, crashes = [], []
+        try:
+            for s in range(SOAK_WAVES):
+                if s > 0:
+                    _soak_wave_retire(store, s - 1)
+                spec = kill_schedule.get(s)
+                if spec is not None:
+                    lease = store.try_get("leases", "volcano")
+                    if lease is not None \
+                            and lease.holder_identity in procs:
+                        from volcano_tpu.models import ConfigMap
+                        store.apply("configmaps", ConfigMap(
+                            name=f"faults-{lease.holder_identity}",
+                            data={"spec": spec}))
+                        kills_armed.append((s, spec))
+                mark = len(bind_events)
+                _soak_wave_submit(store, s)
+                deadline = time.time() + 180
+                while not _soak_wave_bound(store, s):
+                    assert time.time() < deadline, \
+                        f"wave {s} lost gang members (binds=" \
+                        f"{bind_events[mark:]}, kills={kills_armed})"
+                    time.sleep(0.05)
+                    for ident, p in list(procs.items()):
+                        if p.poll() is not None:
+                            if p.returncode == 17:  # exc:exit crash
+                                crashes.append((s, ident))
+                            del procs[ident]
+                            spawn()  # dead leader rejoins as standby
+                rec.begin_cycle(s)
+                for pod, node in bind_events[mark:]:
+                    rec.record_bind(pod, node)
+                rec.end_cycle()
+            return rec.lines, len(dup_binds), crashes
+        finally:
+            for p in procs.values():
+                p.terminate()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            server.stop()
+
+    def test_fifty_waves_with_leader_kills_match_golden(self, tmp_path):
+        from volcano_tpu.sim.replay import first_divergence
+
+        rng = random.Random(42)
+        kill_cycles = sorted(rng.sample(range(3, SOAK_WAVES - 3), 8))
+        # every seam gets at least one kill; the rest draw randomly
+        specs = (SOAK_KILL_SPECS
+                 + [rng.choice(SOAK_KILL_SPECS) for _ in range(3)])
+        kill_schedule = dict(zip(kill_cycles, specs))
+
+        golden, golden_dups, golden_crashes = self._driver(
+            tmp_path, kill_schedule={}, procs_wanted=1)
+        chaos, chaos_dups, crashes = self._driver(
+            tmp_path, kill_schedule=kill_schedule, procs_wanted=2)
+
+        assert golden_dups == 0 and chaos_dups == 0
+        assert golden_crashes == []
+        # the soak must have CRASHED real leaders at the armed seams
+        # (exit 17 = the injector's simulated SIGKILL), not just armed
+        assert len(crashes) >= 5, f"too few leader crashes: {crashes}"
+        div = first_divergence(golden, chaos)
+        assert div is None, f"decision trace diverged: {div}"

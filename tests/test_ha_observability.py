@@ -170,8 +170,12 @@ class TestSchedulerHA:
 
         sched = Scheduler(cache)
         stop = threading.Event()
+        # warm_standby off: this test is about lease GATING, and a shadow
+        # cycle's first solver compile would stall the takeover check;
+        # tests/test_failover.py::TestShadowCycle covers the warm path
         t = threading.Thread(
-            target=sched.run_with_leader_election, args=(stop,), daemon=True)
+            target=sched.run_with_leader_election, args=(stop,),
+            kwargs={"warm_standby": False}, daemon=True)
         sched.period = 0.01
         t.start()
         import time

@@ -498,6 +498,74 @@ class TestRequestRetry:
             remote.close()
             server.stop()
 
+    def _lose_ack_once(self, monkeypatch, for_ops):
+        """Deliver the request frame, then break the connection on the
+        RESPONSE read — the applied-but-unacked window (a failure inside
+        the send itself is unambiguous and always retry-safe)."""
+        import volcano_tpu.client.remote as remote_mod
+        orig_send = remote_mod.send_frame
+        orig_recv = remote_mod.recv_frame
+        dropped = []
+        state = {"armed": None}
+
+        def send(sock, payload):
+            orig_send(sock, payload)
+            if payload.get("op") in for_ops and not dropped:
+                state["armed"] = payload.get("op")
+
+        def recv(sock):
+            if state["armed"] is not None:
+                dropped.append(state["armed"])
+                state["armed"] = None
+                raise ConnectionError("simulated ack loss")
+            return orig_recv(sock)
+
+        monkeypatch.setattr(remote_mod, "send_frame", send)
+        monkeypatch.setattr(remote_mod, "recv_frame", recv)
+        return dropped
+
+    def test_unacked_update_retries_conditionally_surfaces_conflict(
+            self, served, monkeypatch):
+        """A bind-shaped update whose ack is lost after the server
+        applied it must NOT double-apply on retry: the carried
+        resource_version re-presents the precondition, so the replay
+        surfaces ConflictError to the caller instead."""
+        from volcano_tpu.client.store import ConflictError
+
+        store, remote = served
+        store.create("nodes", build_node("n1", {"cpu": "1"}))
+        node = remote.get("nodes", "n1")
+        node.labels = {"zone": "a"}
+        dropped = self._lose_ack_once(monkeypatch, ("update",))
+        with pytest.raises(ConflictError):
+            remote.update("nodes", node)
+        assert dropped == ["update"]
+        assert store.get("nodes", "n1").labels == {"zone": "a"}  # applied ONCE
+
+    def test_unacked_create_retries_and_surfaces_conflict(
+            self, served, monkeypatch):
+        from volcano_tpu.client.store import ConflictError
+
+        store, remote = served
+        dropped = self._lose_ack_once(monkeypatch, ("create",))
+        with pytest.raises(ConflictError):
+            remote.create("nodes", build_node("n1", {"cpu": "1"}))
+        assert dropped == ["create"]
+        assert len(store.list("nodes")) == 1  # exactly one, not two
+
+    def test_unacked_unconditional_update_still_raises_transport_error(
+            self, served, monkeypatch):
+        """No resource_version = no precondition: replaying would be a
+        blind double-apply, so the transport error surfaces instead."""
+        from volcano_tpu.models import Node
+
+        store, remote = served
+        store.create("nodes", build_node("n1", {"cpu": "1"}))
+        bare = Node(name="n1", allocatable={"cpu": "2"})  # rv 0
+        self._lose_ack_once(monkeypatch, ("update",))
+        with pytest.raises((ConnectionError, OSError)):
+            remote.update("nodes", bare)
+
 
 # ---------------------------------------------------------------------------
 # watch-stream resume

@@ -303,6 +303,14 @@ class SchedulerCache:
         # Scheduler installs one; sessions read it for the device -> host
         # oracle degradation ladder in allocate/preempt/reclaim
         self.breaker = None
+        # crash-safe HA seams (resilience/recovery.py + client.store
+        # FencedStore), both installed by run_with_leader_election and
+        # None everywhere else: the write-ahead bind-intent journal
+        # (consumed by Statement.commit / flush_bulk_commit) and the
+        # fenced store handle the effectors write through once fencing
+        # is on
+        self.bind_journal = None
+        self.fenced_cluster = None
 
         # job uid -> flat_version reflected by the last successful status
         # write; the job updater's skip-if-untouched check compares against
@@ -327,6 +335,24 @@ class SchedulerCache:
                     Queue(name=self.default_queue, spec=QueueSpec(weight=1)))
             except ConflictError:
                 pass  # a peer created it between our read and write
+
+    def install_fencing(self, token_provider) -> None:
+        """Route every effector write (bind, evict, status update, volume
+        pin) through a FencedStore carrying ``token_provider()``'s lease
+        token, so the authoritative store — not the writer's own view of
+        its leadership — arbitrates split brain (client.store.FencedStore;
+        Omega-style optimistic commit fencing). Only effectors still
+        pointed at this cache's raw cluster are rewired: fakes and
+        recording decorators are left alone. Idempotent."""
+        from ..client.store import FencedStore
+        if self.fenced_cluster is not None:
+            return
+        fenced = FencedStore(self.cluster, token_provider)
+        self.fenced_cluster = fenced
+        for effector in (self.binder, self.evictor, self.status_updater,
+                         self.volume_binder):
+            if getattr(effector, "cluster", None) is self.cluster:
+                effector.cluster = fenced
 
     def run(self) -> None:
         """Subscribe to the store's watch streams (informer start).

@@ -13,22 +13,28 @@ class FakeBinder:
     def __init__(self):
         self.binds: Dict[str, str] = {}   # "ns/pod" -> node
         self.channel: List[str] = []
+        # the pod objects themselves, for callers that must resync the
+        # cache mirror after a write-free run (Scheduler.shadow_cycle)
+        self.bound_pods: List[object] = []
 
     def bind(self, pod, hostname: str) -> None:
         key = f"{pod.namespace}/{pod.name}"
         self.binds[key] = hostname
         self.channel.append(key)
+        self.bound_pods.append(pod)
 
 
 class FakeEvictor:
     def __init__(self):
         self.evicts: List[str] = []
         self.channel: List[str] = []
+        self.evicted_pods: List[object] = []
 
     def evict(self, pod, reason: str) -> None:
         key = f"{pod.namespace}/{pod.name}"
         self.evicts.append(key)
         self.channel.append(key)
+        self.evicted_pods.append(pod)
 
 
 class RecordingBinder:

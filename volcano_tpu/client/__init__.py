@@ -3,6 +3,6 @@
 from .remote import RemoteClusterStore  # noqa: F401
 from .server import StoreServer  # noqa: F401
 from .store import (  # noqa: F401
-    AdmissionError, ClusterStore, ConflictError, NotFoundError,
-    ResumeGapError,
+    AdmissionError, ClusterStore, ConflictError, FencedError, FencedStore,
+    NotFoundError, ResumeGapError,
 )

@@ -144,14 +144,25 @@ class RemoteClusterStore:
         # Retry rules: a failed SEND is always safe to retry (the server
         # only acts on complete frames, and a broken connection can never
         # complete a partial one). A failure AFTER the send is ambiguous —
-        # the server may have applied the op — so only idempotent reads
-        # retry there; a mutating op surfaces the error to its caller
-        # rather than risk double-apply. Retries back off exponentially
-        # with jitter (base -> cap), so a briefly-restarting server (a
-        # 2-second systemd bounce) is ridden out instead of failing the
-        # first read — and a thundering herd of reconnecting clients
-        # spreads instead of synchronizing.
-        idempotent = payload.get("op") in ("get", "list", "ping")
+        # the server may have applied the op. Idempotent reads always
+        # retry there. A mutating op retries only when it is CONDITIONAL:
+        # create/delete land at most once (a replay of an applied-but-
+        # unacked attempt surfaces ConflictError/NotFoundError instead of
+        # double-applying), and update/apply carrying a nonzero
+        # resource_version re-present the same precondition, so the
+        # replay of an applied bind surfaces ConflictError. Unconditional
+        # mutations (version-0 update/apply) surface the transport error
+        # to their caller rather than risk blind double-apply. Retries
+        # back off exponentially with jitter (base -> cap), so a
+        # briefly-restarting server (a 2-second systemd bounce) is ridden
+        # out — and a thundering herd of reconnecting clients spreads
+        # instead of synchronizing.
+        op = payload.get("op")
+        idempotent = op in ("get", "list", "ping")
+        conditional = op in ("create", "delete") or (
+            op in ("update", "apply")
+            and bool(((payload.get("obj") or {}).get("f") or {})
+                     .get("resource_version")))
         delay = self.retry_base_s
         attempt = 0
         with self._conn_lock:
@@ -173,7 +184,7 @@ class RemoteClusterStore:
                             pass
                         self._conn = None
                     attempt += 1
-                    if (sent and not idempotent) \
+                    if (sent and not (idempotent or conditional)) \
                             or attempt > self.retry_attempts \
                             or self._closed:
                         raise
@@ -214,22 +225,26 @@ class RemoteClusterStore:
     def locked(self):
         return self._lock
 
-    def create(self, kind: str, obj):
+    def create(self, kind: str, obj, fencing: Optional[dict] = None):
         return decode(self._request(
-            {"op": "create", "kind": kind, "obj": encode(obj)})["obj"])
+            {"op": "create", "kind": kind, "obj": encode(obj),
+             "fencing": fencing})["obj"])
 
-    def update(self, kind: str, obj):
+    def update(self, kind: str, obj, fencing: Optional[dict] = None):
         return decode(self._request(
-            {"op": "update", "kind": kind, "obj": encode(obj)})["obj"])
+            {"op": "update", "kind": kind, "obj": encode(obj),
+             "fencing": fencing})["obj"])
 
-    def apply(self, kind: str, obj):
+    def apply(self, kind: str, obj, fencing: Optional[dict] = None):
         return decode(self._request(
-            {"op": "apply", "kind": kind, "obj": encode(obj)})["obj"])
+            {"op": "apply", "kind": kind, "obj": encode(obj),
+             "fencing": fencing})["obj"])
 
-    def delete(self, kind: str, name: str, namespace: Optional[str] = None):
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None,
+               fencing: Optional[dict] = None):
         return decode(self._request(
             {"op": "delete", "kind": kind, "name": name,
-             "namespace": namespace})["obj"])
+             "namespace": namespace, "fencing": fencing})["obj"])
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None):
         return decode(self._request(

@@ -40,8 +40,8 @@ import hmac
 
 from .codec import decode, encode
 from .store import (
-    KINDS, AdmissionError, ClusterStore, ConflictError, NotFoundError,
-    ResumeGapError,
+    KINDS, AdmissionError, ClusterStore, ConflictError, FencedError,
+    NotFoundError, ResumeGapError,
 )
 
 log = logging.getLogger(__name__)
@@ -58,6 +58,7 @@ _ERRORS = {
     "NotFoundError": NotFoundError,
     "AdmissionError": AdmissionError,
     "ResumeGapError": ResumeGapError,
+    "FencedError": FencedError,
 }
 
 
@@ -221,11 +222,17 @@ class _Handler(socketserver.BaseRequestHandler):
     @staticmethod
     def _dispatch(store: ClusterStore, op: str, req: dict) -> dict:
         kind = req.get("kind")
+        # fencing tokens ride the frame; the authoritative store validates
+        # them against ITS lease record (the deposed writer's view of its
+        # own leadership is exactly what cannot be trusted client-side)
+        fencing = req.get("fencing") or None
         if op in ("create", "update", "apply"):
-            obj = getattr(store, op)(kind, decode(req["obj"]))
+            obj = getattr(store, op)(kind, decode(req["obj"]),
+                                     fencing=fencing)
             return {"ok": True, "obj": encode(obj)}
         if op == "delete":
-            obj = store.delete(kind, req["name"], req.get("namespace"))
+            obj = store.delete(kind, req["name"], req.get("namespace"),
+                               fencing=fencing)
             return {"ok": True, "obj": encode(obj)}
         if op == "get":
             obj = store.get(kind, req["name"], req.get("namespace"))

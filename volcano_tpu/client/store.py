@@ -16,6 +16,7 @@ webhook-manager's mutate/validate path.
 from __future__ import annotations
 
 import fnmatch
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 Listener = Callable[[str, Any, Optional[Any]], None]  # (event, obj, old) event in {add, update, delete}
@@ -24,7 +25,7 @@ Interceptor = Callable[[str, str, Any], Any]  # (verb, kind, obj) -> obj (may ra
 KINDS = (
     "pods", "nodes", "podgroups", "queues", "priorityclasses",
     "resourcequotas", "jobs", "commands", "services", "configmaps",
-    "secrets", "pvcs", "leases", "networkpolicies",
+    "secrets", "pvcs", "leases", "networkpolicies", "bindintents",
 )
 
 
@@ -38,6 +39,15 @@ class NotFoundError(KeyError):
 
 class ConflictError(Exception):
     """Stale-object write (resource_version mismatch)."""
+
+
+class FencedError(ConflictError):
+    """A mutating write carried a stale lease fencing token: the writer is
+    no longer (or never was) the lease holder the store knows, so the
+    write is refused before touching any state. Subclasses ConflictError
+    so untyped callers degrade to conflict handling (a fence IS an
+    optimistic-concurrency rejection — of the writer's leadership rather
+    than one object's version)."""
 
 
 class ResumeGapError(Exception):
@@ -66,6 +76,9 @@ class ClusterStore:
         self._interceptors: List[Interceptor] = []
         self._lock = threading.RLock()
         self._rv = 0
+        # fencing arbitration clock (injectable so HA tests drive lease
+        # expiry deterministically); only consulted for fenced writes
+        self.clock: Callable[[], float] = time.time
         # global rv of the LAST event committed per kind — the watch-resume
         # seam (server.EventJournal) needs "has anything happened to this
         # kind since rv X" answerable without scanning a journal
@@ -119,10 +132,51 @@ class ClusterStore:
         with self._lock:
             return self._kind_rv[kind]
 
+    # -- lease fencing ------------------------------------------------------
+
+    def _check_fence(self, fencing: Optional[dict]) -> None:
+        """Refuse a mutating write whose lease fencing token is stale.
+
+        The token names the Lease the writer holds ({lock, holder, epoch});
+        the STORE's current lease record arbitrates — a deposed leader's
+        view of its own leadership is exactly what cannot be trusted. The
+        write is fenced out when the lease is gone, held by someone else,
+        re-acquired since (epoch = lease_transitions at acquisition), or
+        expired by the store's own clock (split-brain where no standby has
+        taken over yet must still not commit). Unfenced writes (no token)
+        pass untouched: fencing is opt-in per writer via FencedStore."""
+        if not fencing:
+            return
+        name = fencing.get("lock", "")
+        lease = self._buckets["leases"].get(name)
+        holder = fencing.get("holder")
+        epoch = fencing.get("epoch", -1)
+        reason = None
+        if lease is None:
+            reason = f"lease {name!r} does not exist"
+        elif lease.holder_identity != holder:
+            reason = (f"lease {name!r} is held by "
+                      f"{lease.holder_identity!r}, not {holder!r}")
+        elif int(epoch) != int(lease.lease_transitions):
+            reason = (f"lease {name!r} was re-acquired (epoch "
+                      f"{lease.lease_transitions} != token epoch {epoch})")
+        elif self.clock() - lease.renew_time > lease.lease_duration_seconds:
+            reason = (f"lease {name!r} expired "
+                      f"{self.clock() - lease.renew_time:.1f}s ago")
+        if reason is not None:
+            try:
+                from ..metrics import metrics
+                metrics.fenced_writes_total.inc(
+                    labels={"holder": str(holder)})
+            except Exception:  # noqa: BLE001 — accounting never masks the fence
+                pass
+            raise FencedError(f"write fenced: {reason}")
+
     # -- CRUD ---------------------------------------------------------------
 
-    def create(self, kind: str, obj):
+    def create(self, kind: str, obj, fencing: Optional[dict] = None):
         with self._lock:
+            self._check_fence(fencing)
             obj = self._admit("create", kind, obj)
             key = _key(obj)
             bucket = self._buckets[kind]
@@ -135,8 +189,9 @@ class ClusterStore:
             self._notify(kind, "add", obj)
             return obj
 
-    def update(self, kind: str, obj):
+    def update(self, kind: str, obj, fencing: Optional[dict] = None):
         with self._lock:
+            self._check_fence(fencing)
             obj = self._admit("update", kind, obj)
             key = _key(obj)
             bucket = self._buckets[kind]
@@ -163,16 +218,18 @@ class ClusterStore:
             self._notify(kind, "update", obj, old)
             return obj
 
-    def apply(self, kind: str, obj):
+    def apply(self, kind: str, obj, fencing: Optional[dict] = None):
         """Create-or-update."""
         with self._lock:
             key = _key(obj)
             if key in self._buckets[kind]:
-                return self.update(kind, obj)
-            return self.create(kind, obj)
+                return self.update(kind, obj, fencing=fencing)
+            return self.create(kind, obj, fencing=fencing)
 
-    def delete(self, kind: str, name: str, namespace: Optional[str] = None):
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None,
+               fencing: Optional[dict] = None):
         with self._lock:
+            self._check_fence(fencing)
             key = f"{namespace}/{name}" if namespace is not None else name
             bucket = self._buckets[kind]
             obj = bucket.pop(key, None)
@@ -216,3 +273,43 @@ class ClusterStore:
                 continue
             out.append(obj)
         return out
+
+
+class FencedStore:
+    """Store proxy attaching the writer's lease fencing token to every
+    mutating op (create/update/apply/delete); reads and watch pass
+    through untouched. ``token_provider`` returns the current token
+    ({lock, holder, epoch}) or None when the writer holds no lease — in
+    which case mutations FAIL CLOSED with FencedError locally: a deposed
+    leader whose elector already observed the loss must not fall back to
+    writing unfenced. Wraps both the in-memory ClusterStore (which
+    validates under its own lock) and RemoteClusterStore (which carries
+    the token on the wire for the StoreServer to validate)."""
+
+    def __init__(self, store, token_provider: Callable[[], Optional[dict]]):
+        self._store = store
+        self._token_provider = token_provider
+
+    def _token(self) -> dict:
+        token = self._token_provider()
+        if token is None:
+            raise FencedError(
+                "write fenced: this writer holds no lease")
+        return token
+
+    def create(self, kind: str, obj):
+        return self._store.create(kind, obj, fencing=self._token())
+
+    def update(self, kind: str, obj):
+        return self._store.update(kind, obj, fencing=self._token())
+
+    def apply(self, kind: str, obj):
+        return self._store.apply(kind, obj, fencing=self._token())
+
+    def delete(self, kind: str, name: str, namespace: Optional[str] = None):
+        return self._store.delete(kind, name, namespace,
+                                  fencing=self._token())
+
+    def __getattr__(self, name):
+        # reads (get/try_get/list/watch/locked/...) forward unfenced
+        return getattr(self._store, name)

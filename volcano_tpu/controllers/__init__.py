@@ -58,6 +58,15 @@ class ControllerManager:
         elector = LeaderElector(
             LeaseLock(self.opt.cluster, lock_name), identity=identity)
         self._elector = elector
+        # fencing: each controller's writes (pod create/delete, job and
+        # podgroup status) carry this manager's lease token, so a deposed
+        # manager's late reconcile is a FencedError instead of a
+        # double-created pod (client.store.FencedStore)
+        from ..client.store import FencedStore
+        fenced = FencedStore(self.opt.cluster, elector.fencing_token)
+        for ctrl in self.controllers:
+            if getattr(ctrl, "cluster", None) is self.opt.cluster:
+                ctrl.cluster = fenced
         renewer = threading.Thread(target=elector.run, args=(stop,),
                                    name="leader-elector", daemon=True)
         renewer.start()

@@ -9,12 +9,16 @@ the state machine, which calls back into sync_job/kill_job.
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+import random
+import time
+from typing import Dict, List, Optional, Tuple
 
 from ...api import Resource
 from ...api.job_info import container_requests
 from ...api.types import POD_GROUP_ANNOTATION
-from ...client.store import AdmissionError, ClusterStore, NotFoundError
+from ...client.store import (
+    AdmissionError, ClusterStore, ConflictError, NotFoundError,
+)
 from ...models import (
     Action, Event, Job, JobPhase, Pod, PodGroup, PodGroupPhase, PodGroupSpec,
 )
@@ -29,7 +33,9 @@ from .state import new_state
 
 log = logging.getLogger(__name__)
 
-MAX_RETRIES = 15
+MAX_RETRIES = 15          # reference maxRetry (job_controller.go)
+RETRY_BASE_S = 0.1        # first backoff delay
+RETRY_CAP_S = 30.0        # backoff ceiling
 
 
 def apply_policies(job: Job, req: Request) -> Action:
@@ -84,6 +90,14 @@ class JobController(Controller):
         # (job_controller_handler.go:98-103: "we only reconcile job based on
         # Spec ... ignored since no update in 'Spec'")
         self._job_obs: Dict[str, tuple] = {}
+        # failed-sync backoff state (reference workqueue rate limiter +
+        # maxRetry): consecutive failure count per job key, and the
+        # deferred requests waiting out their delay as (not_before, req).
+        # Injectable clock/rng keep the schedule testable/deterministic.
+        self._retry_counts: Dict[str, int] = {}
+        self._deferred: List[Tuple[float, Request]] = []
+        self.clock = time.time
+        self.retry_rng = random.Random(0)
 
     def name(self) -> str:
         return "job-controller"
@@ -108,12 +122,49 @@ class JobController(Controller):
         c.watch("podgroups", self._on_podgroup)
         c.watch("commands", self._on_command)
 
+    def _retry_later(self, req: Request) -> None:
+        """Schedule a failed request's re-enqueue with capped exponential
+        backoff + jitter per job key (reference maxRetry + the workqueue
+        rate limiter): immediate unbounded re-enqueues would hot-loop a
+        permanently failing sync against the control plane. After
+        MAX_RETRIES consecutive failures the request is dropped — the
+        next genuine watch event for the job starts a fresh budget."""
+        from ...metrics import metrics
+        count = self._retry_counts.get(req.key, 0) + 1
+        self._retry_counts[req.key] = count
+        if count > MAX_RETRIES:
+            log.error("giving up on %s after %d failed syncs", req.key,
+                      count - 1)
+            self._retry_counts.pop(req.key, None)
+            return
+        delay = min(RETRY_BASE_S * (2 ** (count - 1)), RETRY_CAP_S)
+        delay *= 0.5 + self.retry_rng.random()  # jitter: spread the herd
+        self._deferred.append((self.clock() + delay, req))
+        metrics.job_retry_total.inc(labels={"job_id": req.key})
+
+    def _drain_due_retries(self, batch: Dict[tuple, Request]) -> None:
+        """Move deferred retries whose delay elapsed into the batch."""
+        if not self._deferred:
+            return
+        now = self.clock()
+        still_waiting = []
+        for not_before, req in self._deferred:
+            if not_before > now:
+                still_waiting.append((not_before, req))
+                continue
+            dedup = (req.namespace, req.job_name, req.task_name,
+                     req.event, req.exit_code, req.action)
+            batch.setdefault(dedup, req)
+        self._deferred = still_waiting
+
     def process_all(self, max_rounds: int = 16) -> None:
         """Drain all shards; new requests produced while processing are
         handled in subsequent rounds. Identical requests are deduplicated
         per round (the reference's workqueue add-if-absent semantics) —
         without this, the watch-event feedback from each sync amplifies the
-        queue exponentially."""
+        queue exponentially. A request whose sync raises re-enqueues with
+        capped exponential backoff per job key (_retry_later) instead of
+        being dropped (or hot-looped)."""
         for _ in range(max_rounds):
             batch: Dict[tuple, Request] = {}
             for q in self.queues:
@@ -122,6 +173,7 @@ class JobController(Controller):
                              req.event, req.exit_code, req.action)
                     batch.setdefault(dedup, req)
                 q.clear()
+            self._drain_due_retries(batch)
             if not batch:
                 return
             for req in batch.values():
@@ -129,6 +181,9 @@ class JobController(Controller):
                     self._process(req)
                 except Exception:
                     log.exception("failed to process request %s", req)
+                    self._retry_later(req)
+                else:
+                    self._retry_counts.pop(req.key, None)
 
     # -- watch handlers (job_controller_handler.go) ---------------------------
 
@@ -230,6 +285,11 @@ class JobController(Controller):
             self.cluster.delete("commands", cmd.name, cmd.namespace)
         except NotFoundError:
             pass
+        except ConflictError:
+            # FencedError included: a deposed HA manager must neither
+            # consume the command nor blow up the watch delivery — the
+            # live manager will process it
+            return
         self._enqueue(Request(cmd.namespace, target.get("name", ""),
                               action=cmd.action,
                               event=Event.COMMAND_ISSUED))

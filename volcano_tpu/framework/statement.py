@@ -13,6 +13,7 @@ import logging
 from typing import List, Tuple
 
 from ..api import Resource, TaskInfo, TaskStatus
+from ..resilience.faultinject import faults
 
 log = logging.getLogger(__name__)
 
@@ -276,6 +277,14 @@ class Statement:
             acc.extend(task for _, task, _ in self.operations)
             self.operations = []
             return
+        # crash-safe window: journal the decided binds BEFORE any effect
+        # dispatches (resilience/recovery.py; leader-only — bind_journal
+        # is None outside HA), then cross the bind_commit fault seam. A
+        # crash landing anywhere past this line leaves a durable intent
+        # the next leader reconciles; a FencedError from the journal
+        # means this writer was deposed and must discard, not commit.
+        _journal_statement_binds(self)
+        faults.fire("bind_commit")
         if self.defer_events:
             self.ssn._fire_allocate_batch(
                 [task for name, task, _ in self.operations
@@ -341,6 +350,57 @@ class Statement:
         self.operations = []
 
 
+def _journal_statement_binds(stmt: "Statement") -> None:
+    """Persist a Statement's decided ALLOCATE wave as one bind intent
+    (see resilience/recovery.py) before any effect dispatches. No-op
+    unless the cache carries a journal (leader-only). FencedError aborts
+    the commit — a deposed leader's decisions discard instead of
+    reaching the cluster; any other journal failure is logged and the
+    commit proceeds (the journal narrows crash windows, it must not
+    widen availability ones)."""
+    journal = getattr(stmt.ssn.cache, "bind_journal", None)
+    if journal is None or not stmt.operations:
+        return
+    tasks = [task for name, task, _ in stmt.operations
+             if name is Op.ALLOCATE]
+    if not tasks:
+        return
+    try:
+        journal.record(tasks)
+    except Exception as e:  # noqa: BLE001 — classify below
+        from ..client.store import FencedError
+        if isinstance(e, FencedError):
+            log.error("bind-intent journal fenced; discarding the "
+                      "deposed leader's statement: %s", e)
+            stmt.discard()
+            raise
+        log.exception("bind-intent journal write failed; committing "
+                      "without the intent record")
+
+
+def _journal_wave_binds(ssn, tasks: list) -> None:
+    """flush_bulk_commit's counterpart of _journal_statement_binds: one
+    intent for the whole merged replay wave."""
+    journal = getattr(ssn.cache, "bind_journal", None)
+    if journal is None or not tasks:
+        return
+    try:
+        journal.record(tasks)
+    except Exception as e:  # noqa: BLE001 — classify below
+        from ..client.store import FencedError
+        if isinstance(e, FencedError):
+            log.error("bind-intent journal fenced; unwinding the "
+                      "deposed leader's replay wave: %s", e)
+            # the deferred allocate events were never fired for this
+            # wave, so the unwind fires nothing either (handler parity
+            # with a discarded deferred statement)
+            for task in tasks:
+                _undo_allocate(ssn, task, fired=False)
+            raise
+        log.exception("bind-intent journal write failed; committing "
+                      "without the intent record")
+
+
 def _undo_allocate(ssn, task: TaskInfo, fired: bool = True) -> None:
     """Reverse one session-side allocate (shared by Statement._unallocate
     and the bulk-commit flush, which outlives its statements)."""
@@ -378,6 +438,10 @@ def flush_bulk_commit(ssn, acc: list) -> None:
     ssn._bulk_commit_acc = None
     if not acc:
         return
+    # same crash-safe window as Statement.commit: intent first, then the
+    # bind_commit fault seam, then effects (see resilience/recovery.py)
+    _journal_wave_binds(ssn, acc)
+    faults.fire("bind_commit")
     ssn._fire_allocate_batch(acc)
     cache = ssn.cache
     tasks = acc

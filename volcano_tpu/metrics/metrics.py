@@ -275,6 +275,21 @@ store_request_retries_total = registry.register(Counter(
 faults_injected_total = registry.register(Counter(
     "volcano_faults_injected_total",
     "Faults fired by the injection harness", ["point"]))
+fenced_writes_total = registry.register(Counter(
+    "volcano_fenced_writes_total",
+    "Mutating store writes rejected by lease fencing (split-brain "
+    "attempts made visible)", ["holder"]))
+bind_intents_total = registry.register(Counter(
+    "volcano_bind_intents_total",
+    "Bind-intent journal activity (recorded / confirmed)", ["event"]))
+recovery_intents_total = registry.register(Counter(
+    "volcano_recovery_intents_total",
+    "Bind-intent bindings reconciled at leadership takeover, by outcome "
+    "(adopted / redriven / conflict / lost)", ["outcome"]))
+job_retry_total = registry.register(Counter(
+    "volcano_job_retry_total",
+    "Job controller re-enqueues after a failed sync (capped exponential "
+    "backoff per job key)", ["job_id"]))
 
 # -- cluster simulator metrics (sim/) ---------------------------------------
 

@@ -79,6 +79,26 @@ class PodGroup:
     resource_version: int = 0
 
 
+@dataclass
+class BindIntent:
+    """Durable record of a gang's bind decision, written to the store
+    BEFORE the bind effects dispatch (resilience/recovery.py's write-ahead
+    journal). ``bindings`` is the decided task->node map as [namespace,
+    pod, node] triples; ``holder``/``epoch`` carry the writer's lease
+    fencing token so a recovering leader can tell which leadership stint
+    decided it. Cluster-scoped (no namespace), like Lease. Lives with the
+    models so the wire codec carries it between HA processes."""
+
+    name: str
+    job: str = ""
+    bindings: List[List[str]] = field(default_factory=list)
+    holder: str = ""
+    epoch: int = 0
+    created: float = 0.0
+    uid: str = field(default_factory=lambda: new_uid("bi"))
+    resource_version: int = 0
+
+
 class QueueState(str, enum.Enum):
     OPEN = "Open"
     CLOSED = "Closed"

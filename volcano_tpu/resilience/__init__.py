@@ -10,15 +10,22 @@ Three coordinated pieces plus the harness that proves them:
 - watch-stream resume lives with the transport it hardens
   (client/server.py ``EventJournal`` + client/remote.py reconnect), with
   the crash-only ``on_watch_failure`` contract kept as its fallback;
+- ``recovery.BindIntentJournal`` / ``recovery.reconcile_bind_intents`` —
+  the crash-safe bind write-ahead journal and the takeover
+  reconciliation pass (wired by scheduler.run_with_leader_election,
+  fenced by client.store.FencedStore);
 - ``faultinject.faults`` — the deterministic, seeded fault-injection
-  harness driving tests/test_resilience.py and ``bench.py chaos_churn``.
+  harness driving tests/test_resilience.py, tests/test_failover.py and
+  ``bench.py chaos_churn``/``failover``.
 """
 
 from .breaker import CircuitBreaker
 from .faultinject import FaultError, FaultInjector, faults
+from .recovery import BindIntentJournal, reconcile_bind_intents
 from .watchdog import ActionTimeout, ActionWatchdog
 
 __all__ = [
-    "ActionTimeout", "ActionWatchdog", "CircuitBreaker",
-    "FaultError", "FaultInjector", "faults",
+    "ActionTimeout", "ActionWatchdog", "BindIntentJournal",
+    "CircuitBreaker", "FaultError", "FaultInjector", "faults",
+    "reconcile_bind_intents",
 ]

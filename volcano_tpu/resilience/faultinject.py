@@ -22,14 +22,24 @@ or env-driven for subprocess targets::
 Spec grammar per point: ``at:3,7`` / ``at:3-5`` (1-based call indices),
 ``every:N`` (each Nth call), ``p:0.1`` (probability), ``times:K`` (cap),
 ``delay:SECS`` (sleep instead of / before raising), ``exc:none`` (delay
-only). Injected exceptions are ``FaultError`` (a ``ConnectionError``
-subclass, so the store/watch retry paths treat them as the genuine
-connection failures they simulate).
+only), ``exc:exit`` (kill the PROCESS at the seam — ``os._exit(17)``, the
+moral equivalent of a SIGKILL landing exactly there; the kill-the-leader
+chaos harness arms this on a live scheduler to crash it at a chosen
+fault point). Injected exceptions are ``FaultError`` (a
+``ConnectionError`` subclass, so the store/watch retry paths treat them
+as the genuine connection failures they simulate).
 
 Known points: ``store_request`` (client/remote._request), ``watch_stream``
 (client/remote watch reader), ``solver_dispatch`` (actions/allocate device
 path), ``evict_dispatch`` (actions/evict_solver), ``slow_action``
-(scheduler per-action wrapper; arm with ``delay:`` to simulate a hang).
+(scheduler per-action wrapper; arm with ``delay:`` to simulate a hang),
+``lease_renew`` (utils/leader_election.step, between deciding to
+acquire/renew and committing the lease write — the split-brain birth
+window), ``bind_commit`` (framework/statement commit / bulk flush, after
+the bind-intent journal write and before any cache bind effect — arming
+``at:1`` crashes pre-commit with the intent durable but nothing applied;
+``at:2`` crashes mid-dispatch with one statement's binds applied and the
+rest only journaled).
 """
 
 from __future__ import annotations
@@ -137,6 +147,8 @@ class FaultInjector:
                     kw["delay"] = float(val)
                 elif key == "exc" and val.lower() in ("none", "off"):
                     kw["exc"] = None
+                elif key == "exc" and val.lower() == "exit":
+                    kw["exc"] = "exit"
                 else:
                     raise ValueError(f"unknown fault spec key {key!r}")
             self.arm(point.strip(), **kw)
@@ -185,6 +197,11 @@ class FaultInjector:
         log.warning("fault injected: %s (call %s)", point, message)
         if delay:
             time.sleep(delay)
+        if exc == "exit":
+            # simulated crash AT the seam: no cleanup, no atexit — the
+            # closest a test can get to SIGKILL landing on this line
+            log.critical("fault %s: simulated crash (os._exit)", point)
+            os._exit(17)
         if exc is not None:
             raise exc(message)
 

@@ -254,21 +254,127 @@ class Scheduler:
             timing["breaker_state"] = float(br.state_code)
             timing["breaker_fallback_cycles"] = float(br.fallback_cycles)
 
+    def shadow_cycle(self) -> None:
+        """One write-free scheduling cycle against the live mirror: the
+        warm-standby trick. The full session pipeline — snapshot, flatten,
+        solve, replay — runs with every effector swapped for a fake, so
+        the standby's process-local XLA executables, flatten/device
+        caches and BucketPrewarmer are exactly as hot as the leader's,
+        and the first post-takeover cycle pays zero solver compiles.
+        Afterwards every mirror mutation the fake-committed binds/evicts
+        made is resynced from store truth, and podgroups are re-read, so
+        the mirror is byte-identical to before the shadow ran."""
+        import copy
+
+        from .cache.fakes import (
+            FakeBinder, FakeEvictor, FakeStatusUpdater, FakeVolumeBinder,
+        )
+
+        cache = self.cache
+        saved = (cache.binder, cache.evictor, cache.status_updater,
+                 cache.volume_binder, cache.bind_journal,
+                 getattr(cache, "decision_recorder", None))
+        shadow_binder, shadow_evictor = FakeBinder(), FakeEvictor()
+        cache.binder, cache.evictor = shadow_binder, shadow_evictor
+        cache.status_updater = FakeStatusUpdater()
+        cache.volume_binder = FakeVolumeBinder()
+        cache.bind_journal = None
+        cache.decision_recorder = None
+        # JobInfo clones SHARE the pod_group object with the mirror (and,
+        # in-process, with the store): give each job a private copy so
+        # the shadow session's phase flips/conditions can't leak out.
+        # Under the store lock: watch deliveries mutate cache.jobs
+        # concurrently on a remote mirror.
+        with cache.cluster.locked():
+            for job in list(cache.jobs.values()):
+                if job.pod_group is not None:
+                    job.set_pod_group(copy.deepcopy(job.pod_group))
+        try:
+            self.load_conf()
+            ssn = open_session(self.cache, self.tiers, self.configurations)
+            ssn.node_sampler = self.node_sampler
+            try:
+                for epoch, action in enumerate(self.actions):
+                    ssn._action_epoch = epoch
+                    try:
+                        self._execute_action(ssn, action)
+                    except Exception:
+                        ssn.discard_open_statements()
+                        log.exception("shadow cycle action %s failed "
+                                      "(contained)", action.name())
+            finally:
+                close_session(ssn)
+        except Exception:
+            log.exception("shadow cycle failed")
+        finally:
+            # drain BEFORE restoring: an async bind effect reads
+            # cache.binder at run time, and must still see the fake
+            try:
+                cache.wait_for_effects()
+            except Exception:  # noqa: BLE001
+                log.exception("shadow cycle effect drain failed")
+            (cache.binder, cache.evictor, cache.status_updater,
+             cache.volume_binder, cache.bind_journal,
+             cache.decision_recorder) = saved
+            # undo the fake-committed mirror mutations from store truth;
+            # resync the STORED task (its node_name reflects the fake
+            # bind) so the node-side accounting unwinds too
+            from .api import TaskInfo
+            for pod in list(shadow_binder.bound_pods) \
+                    + list(shadow_evictor.evicted_pods):
+                ti = TaskInfo(pod)
+                cache.resync_task(cache._stored_task(ti) or ti)
+            cache.process_resync_tasks()
+            try:
+                for pg in cache.cluster.list("podgroups"):
+                    cache.set_pod_group(pg)
+            except Exception:  # noqa: BLE001 — store briefly away: mirror
+                log.exception("shadow cycle podgroup refresh failed")
+            # re-baseline the compile accounting: executables built during
+            # the shadow belong to the standby era, so the first REAL
+            # post-takeover cycle reports session_compiles == 0 when the
+            # warm-up did its job (the failover bench's assertion)
+            from .ops.precompile import watcher
+            self._compile_totals = watcher.session_totals()
+
     def run_with_leader_election(self, stop, lock_name: str = "volcano",
                                  identity: Optional[str] = None,
                                  lease_duration: Optional[float] = None,
                                  renew_deadline: Optional[float] = None,
-                                 retry_period: Optional[float] = None) -> None:
+                                 retry_period: Optional[float] = None,
+                                 warm_standby: bool = True) -> None:
         """HA mode (cmd/scheduler/app/server.go:85-145): only the lease
         holder schedules; standbys poll the lease and take over on expiry.
         The lease timings are overridable (tests shrink them to fail over
         in seconds; the defaults match the reference's 15/10/5).
+
+        Crash-safe failover ladder (Borg/Omega, PAPERS.md):
+
+        - **fencing** — every effector write carries this elector's lease
+          token (cache.install_fencing); a deposed leader's late commit
+          is a FencedError, not a split-brain bind;
+        - **bind-intent journal** — the leader journals each decided bind
+          wave before dispatching it (resilience/recovery.py), and sweeps
+          confirmed intents once per cycle;
+        - **recovery** — at every leadership acquisition the surviving
+          intents reconcile against pod truth (adopt / re-drive) BEFORE
+          the first cycle;
+        - **warm standby** — the mirror subscribes immediately (not at
+          first leadership) and, with ``warm_standby``, the standby runs
+          write-free shadow cycles so takeover starts with hot compile/
+          flatten caches: under one lease duration to the first bind,
+          zero solver compiles in the first post-takeover cycle;
+        - **drain-then-release** — on stop, the lease is released only
+          after the async bind effectors drained.
 
         Lease renewal runs on its own thread at the elector's retry period
         (like client-go's renew loop), so a long scheduling cycle or a long
         schedule-period can't blow the renew deadline."""
         import threading
 
+        from .resilience.recovery import (
+            BindIntentJournal, reconcile_bind_intents,
+        )
         from .utils import LeaderElector, LeaseLock
         from .utils.leader_election import (
             LEASE_DURATION, RENEW_DEADLINE, RETRY_PERIOD,
@@ -280,25 +386,55 @@ class Scheduler:
             renew_deadline=renew_deadline or RENEW_DEADLINE,
             retry_period=retry_period or RETRY_PERIOD)
         self._elector = elector
-        renewer = threading.Thread(target=elector.run, args=(stop,),
+        self.cache.install_fencing(elector.fencing_token)
+        journal = BindIntentJournal(self.cache.fenced_cluster,
+                                    identity=elector.identity)
+        renewer = threading.Thread(target=elector.run,
+                                   args=(stop,), kwargs={
+                                       "release_on_stop": False},
                                    name="leader-elector", daemon=True)
         renewer.start()
-        synced = False
+        # warm standby: the mirror subscribes NOW, leader or not
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+        was_leader = False
+        last_shadow = 0.0
         while not stop.is_set():
             if elector.is_leader:
-                if not synced:
-                    self.cache.run()
-                    self.cache.wait_for_cache_sync()
-                    synced = True
+                if not was_leader:
+                    # takeover: settle the dead leader's journaled binds
+                    # before scheduling anything
+                    try:
+                        reconcile_bind_intents(self.cache.cluster,
+                                               elector.fencing_token)
+                    except Exception:
+                        log.exception("bind-intent recovery failed; "
+                                      "retrying before the first cycle")
+                        stop.wait(0.05)
+                        continue
+                    self.cache.bind_journal = journal
+                    was_leader = True
                 self.cache.process_resync_tasks()
                 try:
                     self.run_once()
                 except Exception:
                     log.exception("scheduling cycle failed")
+                journal.sweep()
                 self._maybe_gc()
                 stop.wait(self.period)
             else:
+                if was_leader:
+                    was_leader = False
+                    self.cache.bind_journal = None
+                if warm_standby \
+                        and time.time() - last_shadow >= self.period:
+                    self.shadow_cycle()
+                    last_shadow = time.time()
                 stop.wait(0.05)
+        # SIGTERM contract: land the in-flight binds, then hand the lease
+        # over — the standby must not take over around live writes
+        self.cache.wait_for_effects()
+        elector.release()
         renewer.join(timeout=2 * elector.retry_period)
 
     def run(self, stop_after: Optional[int] = None) -> None:

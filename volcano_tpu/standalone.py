@@ -232,8 +232,11 @@ class Standalone:
 
             elector = LeaderElector(LeaseLock(self.store, "volcano"))
             self._elector = elector
+            # release is deferred to stop(): the SIGTERM contract hands
+            # the lease over only after the async bind effectors drained
             renewer = threading.Thread(target=elector.run,
                                        args=(self._stop,),
+                                       kwargs={"release_on_stop": False},
                                        name="leader-elector", daemon=True)
             renewer.start()
         while not self._stop.is_set():
@@ -252,6 +255,10 @@ class Standalone:
     def stop(self) -> None:
         self._stop.set()
         self.cache.wait_for_effects()  # land in-flight pipelined binds
+        if self._elector is not None:
+            # release AFTER the drain: a standby taking over mid-drain
+            # would race this process's last bind writes
+            self._elector.release()
         if self._sim_record_file is not None:
             self._sim_record_file.close()
             self._sim_record_file = None
@@ -376,6 +383,12 @@ def main(argv=None) -> int:
           f":{sa.metrics_server.port}"
           + (f"; store on {sa.store_server.address}"
              if sa.store_server else ""), flush=True)
+    # graceful SIGTERM: stop the loop; the finally below drains the
+    # async bind effectors and only then releases the HA lease, so a
+    # standby's takeover never races this process's in-flight binds
+    import signal
+    signal.signal(signal.SIGTERM,
+                  lambda *_a: sa._stop.set())
     try:
         sa.run()
     except KeyboardInterrupt:

@@ -75,6 +75,11 @@ class LeaderElector:
         self.clock = clock
         self.is_leader = False
         self._last_renew = 0.0
+        # fencing: the lease_transitions value of OUR acquisition — the
+        # write epoch carried by every fenced store write (FencedStore),
+        # so the store can reject a deposed leader's late commit even when
+        # the same identity later re-acquires (epoch bumps per transition)
+        self.fence_epoch: Optional[int] = None
 
     # -- one protocol step (testable) ---------------------------------------
 
@@ -110,6 +115,11 @@ class LeaderElector:
         new.renew_time = now
         new.lease_duration_seconds = self.lease_duration
         try:
+            # chaos seam: a crash (or drop) exactly between deciding to
+            # renew and committing the renewal — the window where a
+            # deposed-leader split brain is born (resilience/faultinject)
+            from ..resilience.faultinject import faults
+            faults.fire("lease_renew")
             self.lock.create_or_update(new)
         except ConflictError:
             # another elector wrote the lease between our read and our write:
@@ -117,6 +127,7 @@ class LeaderElector:
             cur = self.lock.get()
             if cur is not None and cur.holder_identity == self.identity:
                 self._last_renew = now
+                self.fence_epoch = cur.lease_transitions
                 self._win()
                 return True
             if self.is_leader:
@@ -125,6 +136,7 @@ class LeaderElector:
         except Exception:
             return self.is_leader
         self._last_renew = now
+        self.fence_epoch = new.lease_transitions
         self._win()
         return True
 
@@ -138,6 +150,16 @@ class LeaderElector:
         self.is_leader = False
         if self.on_stopped_leading is not None:
             self.on_stopped_leading()
+
+    def fencing_token(self) -> Optional[dict]:
+        """The token every fenced store write must carry ({lock, holder,
+        epoch}; see client.store.FencedStore), or None when this elector
+        does not currently believe it leads — FencedStore then fails the
+        write closed instead of writing unfenced."""
+        if not self.is_leader or self.fence_epoch is None:
+            return None
+        return {"lock": self.lock.name, "holder": self.identity,
+                "epoch": self.fence_epoch}
 
     def release(self) -> None:
         """Voluntarily give up the lease (clean shutdown)."""
@@ -154,8 +176,14 @@ class LeaderElector:
 
     # -- wall-clock loop ----------------------------------------------------
 
-    def run(self, stop: threading.Event) -> None:
+    def run(self, stop: threading.Event,
+            release_on_stop: bool = True) -> None:
+        """Renew until ``stop``; ``release_on_stop=False`` leaves the
+        release to the caller — the SIGTERM contract releases only AFTER
+        the async bind effectors drained, so a standby cannot take over
+        with this leader's binds still in flight."""
         while not stop.is_set():
             self.step()
             stop.wait(self.retry_period)
-        self.release()
+        if release_on_stop:
+            self.release()
