@@ -237,20 +237,28 @@ class TestCollectFailureFallback:
         monkeypatch.setattr(solver_mod, "decode_compact", boom)
         sched.run_once()
         assert calls["n"] == 1
-        # device cache dropped: mirror AND cached device params are gone
+        # soft invalidation: the donated chunked buffers are dropped (the
+        # failed dispatch consumed them), but the never-donated pinned
+        # params and their content blob SURVIVE for re-validation — a
+        # collect failure costs one full re-ship, not a cold arena
         dc = cache.device_cache
-        assert dc._layout is None and dc._host_f is None
-        assert getattr(dc, "_params_blob", None) is None
+        assert dc._layout is None and dc._dev_f is None
+        assert dc._params_blob is not None
+        assert dc.invalidations == 1
+        repins_after_fault = dc.params_repins
         # the session still placed every pod, via the host oracle
         assert len(cache.binder.binds) == 6
         assert sched.last_cycle_timing.get("host_fallback") == 1.0
 
-        # next cycle recovers on the device path (full re-ship)
+        # next cycle recovers on the device path: full re-ship of the
+        # chunked buffers, params re-validated in place (no re-upload)
         monkeypatch.setattr(solver_mod, "decode_compact", real_decode)
         wave(3)
         sched.run_once()
         assert len(cache.binder.binds) == 8
         assert dc._layout is not None
+        assert dc.last_full_ship
+        assert dc.params_repins == repins_after_fault
         assert "host_fallback" not in sched.last_cycle_timing
 
 

@@ -297,27 +297,55 @@ class AllocateAction(Action):
                 # single-device dispatch uses. The sim's scheduling-quality
                 # A/B runs this arm against the host oracle and the plain
                 # device solver on the same seed; multi-chip deployments
-                # get the identical code path with a wider mesh.
+                # get the identical code path with a wider mesh. The
+                # dispatch gets one transient-transport retry (a dropped
+                # remote_compile stream re-sends instead of burning a
+                # breaker failure — BENCH_r05's abort mode), and anything
+                # that still fails degrades through the same breaker +
+                # host-oracle ladder as the packed path.
                 import jax
 
                 from ..parallel import (
                     make_mesh, solve_allocate_sharded_packed2d,
                 )
+                from ..resilience.transient import retry_transient
                 fbuf, ibuf, layout = arr.packed()
                 if dc is not None:
                     f2d, i2d = dc.update(fbuf, ibuf, layout)
                     params = dc.params_device(params)
+                    timing["arena_bytes_shipped"] = \
+                        float(dc.last_shipped_bytes)
+                    timing["arena_full_ship"] = float(dc.last_full_ship)
                 else:
                     from ..ops.device_cache import PackedDeviceCache
                     f2d, i2d = PackedDeviceCache().update(fbuf, ibuf, layout)
                     params = {k: jax.device_put(np.asarray(v))
                               for k, v in params.items()}
-                r = solve_allocate_sharded_packed2d(
-                    f2d, i2d, layout, params,
-                    make_mesh(jax.devices()[:1]), herd_mode=herd,
-                    score_families=families, use_queue_cap=use_queue_cap,
-                    use_drf_order=use_drf_order,
-                    use_hdrf_order=use_hdrf_order)
+                mesh = make_mesh(jax.devices()[:1])
+                pw = getattr(ssn, "prewarmer", None)
+                if pw is not None and pw.mesh is None:
+                    # sharded sessions must pre-warm (and persistent-cache)
+                    # the sharded solve variants too, not just packed2d
+                    pw.mesh = mesh
+                if dc is not None:
+                    # flags snapshot so the bucket prewarmer can predict
+                    # this mode's next-bucket variants (the sharded warm
+                    # rides the same observe path as packed2d)
+                    dc.last_solve_flags = dict(
+                        layout=layout, herd_mode=herd,
+                        score_families=families,
+                        use_queue_cap=use_queue_cap,
+                        use_drf_order=use_drf_order,
+                        use_hdrf_order=use_hdrf_order,
+                        work_conserving=work_conserving)
+                r = retry_transient(
+                    lambda: solve_allocate_sharded_packed2d(
+                        f2d, i2d, layout, params, mesh, herd_mode=herd,
+                        score_families=families,
+                        use_queue_cap=use_queue_cap,
+                        use_drf_order=use_drf_order,
+                        use_hdrf_order=use_hdrf_order),
+                    what="sharded solver dispatch")
                 # SolveResult.compact is not produced by the sharded
                 # kernel; collect assigned/kind directly (sidecar shape)
                 assigned = np.asarray(r.assigned)
@@ -363,6 +391,8 @@ class AllocateAction(Action):
                 timing["delta_plan_ms"] = (_time.perf_counter() - t1) * 1e3
                 timing["delta_chunks"] = float(dc.last_shipped_chunks)
                 timing["delta_fused"] = float(kind_ == "fused")
+                timing["arena_bytes_shipped"] = float(dc.last_shipped_bytes)
+                timing["arena_full_ship"] = float(dc.last_full_ship)
                 t1 = _time.perf_counter()
                 if kind_ == "updated":
                     f2d, i2d = payload
@@ -384,9 +414,12 @@ class AllocateAction(Action):
                             use_hdrf_order=use_hdrf_order,
                             work_conserving=work_conserving)
                     except Exception:
-                        # donation may have consumed the buffers: drop the
-                        # mirror so the next session re-ships in full
-                        dc.reset()
+                        # donation may have consumed the buffers — but the
+                        # host mirror and the (never-donated) pinned params
+                        # are fine: soft-invalidate so the next session
+                        # re-ships the chunked buffers and re-validates the
+                        # params in place instead of rebuilding cold
+                        dc.invalidate()
                         raise
                     dc.commit(new_f, new_i)
                 timing["dispatch_ms"] = (_time.perf_counter() - t1) * 1e3
@@ -419,6 +452,12 @@ class AllocateAction(Action):
         statements = None
         if res is not None and pipelined:
             t1 = _time.perf_counter()
+            # previous-phase readback starts NOW: begin the device->host
+            # result transfer asynchronously so the wire RTT overlaps the
+            # solve tail and the replay-prep below instead of being paid
+            # serially when the collect blocks (ops.pipeline)
+            from ..ops.pipeline import start_readback
+            start_readback(res.compact, res.assigned, res.kind)
             node_names = [n.name for n in arr.nodes_list]
             # Statement construction is pure (no session registration
             # until ops are recorded), so the replay's per-job statements
@@ -469,17 +508,21 @@ class AllocateAction(Action):
                 # compile-stall protection
                 self._observe_prewarm(ssn, arr, dc)
         else:
-            # sidecar path: assignments are already host arrays
+            # sharded/sidecar path: assignments are already host arrays
             try:
                 self._check_solver_output(np.asarray(assigned),
                                           np.asarray(kind),
                                           len(tasks_in_order),
                                           len(arr.nodes_list))
             except Exception:
-                log.exception("sidecar solver output failed validation; "
-                              "falling back to the host loop")
+                log.exception("sharded/sidecar solver output failed "
+                              "validation; falling back to the host loop")
                 self._device_fault_fallback(ssn, dc, timing, breaker)
                 return
+            # these modes skip the dispatch/collect overlap window above,
+            # so the occupancy check runs here — a sharded session's
+            # bucket crossing must pre-warm its own (sharded) variants
+            self._observe_prewarm(ssn, arr, dc)
         if breaker is not None:
             # a full dispatch+collect round-trip with sane output: the
             # device path is healthy (closes a half-open breaker)
@@ -513,14 +556,17 @@ class AllocateAction(Action):
 
     def _device_fault_fallback(self, ssn, dc, timing, breaker) -> None:
         """Shared device-failure containment: count the failure against
-        the circuit breaker, drop the (possibly poisoned) device-resident
-        buffers, and finish THIS session through the host oracle — a
-        device fault costs one slow cycle, never a scheduling gap
-        (degradation ladder: device -> host oracle -> skip cycle)."""
+        the circuit breaker, invalidate the (possibly poisoned) donated
+        device buffers — keeping the host mirror and the never-donated
+        pinned params for re-validation next session — and finish THIS
+        session through the host oracle: a device fault costs one slow
+        cycle plus one full re-ship, never a scheduling gap or a
+        permanently cold arena (degradation ladder: device -> host
+        oracle -> skip cycle)."""
         if breaker is not None:
             breaker.record_failure()
         if dc is not None:
-            dc.reset()
+            dc.invalidate()
         timing["host_fallback"] = 1.0
         ssn.solver_options["_post_host_jobs"] = []
         self._execute_host(ssn)

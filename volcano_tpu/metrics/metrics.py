@@ -245,6 +245,32 @@ session_phase_ms = registry.register(Gauge(
     "volcano_session_phase_milliseconds",
     "Per-phase latency of the last scheduling cycle", ["phase"]))
 
+# -- device-resident arena metrics (ops.device_cache + ops.pipeline) --------
+
+arena_bytes_shipped = registry.register(Gauge(
+    "volcano_arena_bytes_shipped",
+    "Wire bytes shipped to the device-resident arena by the last "
+    "scheduling session (dirty chunks only in steady state)"))
+arena_bytes_shipped_total = registry.register(Gauge(
+    "volcano_arena_bytes_shipped_total",
+    "Cumulative wire bytes shipped to the device-resident arena"))
+arena_hit_rate = registry.register(Gauge(
+    "volcano_arena_hit_rate",
+    "Fraction of sessions served by a delta against the resident arena "
+    "(1.0 = no full re-ship since the first session)"))
+arena_sessions_total = registry.register(Gauge(
+    "volcano_arena_sessions_total",
+    "Arena sessions by outcome (delta = dirty-chunk ship, full = "
+    "full padded-buffer upload)", ["outcome"]))
+arena_invalidations_total = registry.register(Gauge(
+    "volcano_arena_invalidations_total",
+    "Soft arena invalidations after collect failures (next session "
+    "full-ships and re-validates pinned params)"))
+arena_params_repins_total = registry.register(Gauge(
+    "volcano_arena_params_repins_total",
+    "Device score-params uploads (content change or failed "
+    "re-validation; steady sessions serve the pinned copy)"))
+
 # -- resilience metrics (resilience/, scheduler containment, store client) --
 
 breaker_state = registry.register(Gauge(

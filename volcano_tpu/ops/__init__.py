@@ -18,10 +18,11 @@ _LAZY_DEVCACHE = ("PackedDeviceCache",)
 # routing it through the lazy hook keeps the import-cost contract uniform
 _LAZY_PRECOMPILE = ("BucketPrewarmer", "CompileWatcher",
                     "configure_compilation_cache", "watcher")
+_LAZY_PIPELINE = ("SessionPipeline", "SessionTicket", "start_readback")
 
 __all__ = ["FlattenCache", "ScoreParams", "SnapshotArrays", "bucket",
            "flatten_snapshot", *_LAZY, *_LAZY_EVICT, *_LAZY_DEVCACHE,
-           *_LAZY_PRECOMPILE]
+           *_LAZY_PRECOMPILE, *_LAZY_PIPELINE]
 
 
 def __getattr__(name):
@@ -37,4 +38,7 @@ def __getattr__(name):
     if name in _LAZY_PRECOMPILE:
         from . import precompile
         return getattr(precompile, name)
+    if name in _LAZY_PIPELINE:
+        from . import pipeline
+        return getattr(pipeline, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,14 +1,32 @@
-"""Device-resident packed solver buffers with chunked delta upload.
+"""Device-resident packed solver arena with chunked delta upload.
 
 The tunnel to a remote TPU is latency- and bandwidth-expensive: re-shipping
 the full packed snapshot (~0.5 MB at 10k tasks / 2k nodes) every session
 costs ~100 ms, while the cluster typically changes a few rows per cycle.
 This cache keeps the two packed buffers (ops.arrays.SnapshotArrays.packed)
-resident on device and ships only the chunks whose bytes changed since the
-previous session, applied with a donated in-place scatter — the TPU-native
-analog of the reference's informer deltas (client-go list-watch keeps the
-scheduler's mirror warm instead of re-listing the cluster,
-pkg/scheduler/cache/cache.go:319-402).
+resident on device ACROSS scheduling sessions and ships only the chunks
+whose bytes changed since the previous session, applied with a donated
+in-place scatter — the TPU-native analog of the reference's informer
+deltas (client-go list-watch keeps the scheduler's mirror warm instead of
+re-listing the cluster, pkg/scheduler/cache/cache.go:319-402).
+
+Arena contract (what survives what):
+
+- **Chunked packed buffers** (``_dev_f``/``_dev_i``): device-resident
+  across sessions; donated into the fused solve each dispatch. Lost on
+  ``invalidate()``/``reset()`` — a donated dispatch that failed at
+  readback has already consumed them.
+- **Score params** (``params_device``): device-resident across sessions,
+  NEVER donated — they survive a collect failure and are re-validated
+  (not re-uploaded) on the next session via ``invalidate()``'s suspect
+  flag. Only content changes or actual device-side deletion re-pin them.
+- **Host mirror** (``_host_f``/``_host_i``): host memory; survives
+  ``invalidate()`` untouched (it is rebuilt by the full re-ship anyway)
+  and exists so per-session diffs are chunk-exact.
+
+Accounting (``last_shipped_bytes``, ``arena_hit_rate`` …) feeds the
+``volcano_arena_*`` metrics, ``Scheduler.last_cycle_timing`` and the
+bench's bytes-shipped-per-session artifact fields.
 """
 
 from __future__ import annotations
@@ -55,22 +73,69 @@ class PackedDeviceCache:
         self._dev_f = None                         # [Cf, chunk] on device
         self._dev_i = None
         self._layout = None
-        self.last_shipped_chunks = 0               # diagnostics
+        self._params_blob = None
+        self._params_dev = None
+        #: device buffers untrusted (collect failure after a donated
+        #: dispatch): next session full-ships and re-validates params
+        self._params_suspect = False
+        # previous mirror buffers recycled as diff scratch (the diff
+        # allocated two full-buffer copies per session before)
+        self._scratch_f: Optional[np.ndarray] = None
+        self._scratch_i: Optional[np.ndarray] = None
+        # -- arena accounting (diagnostics + volcano_arena_* metrics) ----
+        self.last_shipped_chunks = 0
+        self.last_shipped_bytes = 0     # wire bytes of the last delta/ship
+        self.last_full_ship = False
+        self.sessions = 0               # update/plan_delta calls
+        self.full_ships = 0             # sessions that re-shipped everything
+        self.delta_sessions = 0         # sessions that shipped a delta
+        self.invalidations = 0          # soft resets (collect failures)
+        self.params_repins = 0          # device params re-uploaded
+        self.total_shipped_bytes = 0
+
+    # -- arena introspection -------------------------------------------
+
+    @property
+    def arena_hit_rate(self) -> float:
+        """Fraction of sessions served by a delta against the resident
+        arena (1.0 = never re-shipped after the first session)."""
+        if not self.sessions:
+            return 0.0
+        return self.delta_sessions / self.sessions
+
+    def full_upload_bytes(self) -> int:
+        """Wire cost of one full padded-buffer upload at the current
+        layout (the denominator of the <10%-of-full acceptance check)."""
+        if self._host_f is None or self._host_i is None:
+            return 0
+        return int(self._host_f.nbytes + self._host_i.nbytes)
 
     def reset(self) -> None:
-        """Drop the mirror AND the device-resident state so the next
-        session re-ships everything. Called on any scatter/dispatch
-        failure here, and by the allocate action's collect path when an
-        async solve error surfaces at readback time — by then a donated
-        dispatch has already commit()ed buffers that no longer hold valid
-        data, so everything device-side (cached score params included: the
-        same fault that killed the solve may have killed their backing
-        buffers) must be treated as lost."""
+        """Hard reset: drop the mirror, the device-resident state AND the
+        pinned params so the next session rebuilds everything. Used when
+        the HOST-side mirror itself may have desynced from the device (a
+        partial scatter failure mid-apply) — after that, nothing this
+        object remembers can be trusted."""
         self._host_f = self._host_i = None
         self._dev_f = self._dev_i = None
         self._layout = None
         self._params_blob = None
         self._params_dev = None
+        self._params_suspect = False
+
+    def invalidate(self) -> None:
+        """Soft reset after an async-collect failure: by the time the
+        error surfaced, a donated dispatch had already consumed the
+        chunked buffers, so they are gone — but the score params were
+        NEVER donated and usually survive, and the host mirror is host
+        memory. Drop exactly what the donation poisoned: the next session
+        full-ships the chunked buffers (one expensive upload, not a
+        permanent cold path) and re-validates the pinned params in place
+        instead of re-uploading them."""
+        self._dev_f = self._dev_i = None
+        self._layout = None  # forces the full re-ship
+        self._params_suspect = True
+        self.invalidations += 1
 
     # -- shared mirror maintenance (update + plan_delta flows) ----------
 
@@ -88,6 +153,18 @@ class PackedDeviceCache:
         self._dev_i = jax.device_put(hi.reshape(ci, c))
         self._layout = layout
         self.last_shipped_chunks = cf + ci
+        self._account(cf + ci, hf.nbytes + hi.nbytes, full=True)
+
+    def _account(self, chunks: int, wire_bytes: int, full: bool) -> None:
+        self.sessions += 1
+        self.last_shipped_chunks = int(chunks)
+        self.last_shipped_bytes = int(wire_bytes)
+        self.last_full_ship = bool(full)
+        self.total_shipped_bytes += int(wire_bytes)
+        if full:
+            self.full_ships += 1
+        else:
+            self.delta_sessions += 1
 
     def _needs_full_ship(self, layout, cf: int, ci: int) -> bool:
         c = self.chunk
@@ -97,18 +174,34 @@ class PackedDeviceCache:
 
     def _diff(self, fbuf, ibuf, cf: int, ci: int):
         """Pad new content into mirror-shaped buffers and locate dirty
-        chunks: (f2, i2, df, di). Does NOT update the mirror."""
+        chunks: (f2, i2, df, di). Does NOT update the mirror (see
+        _commit_mirror). The padded buffers come from the scratch pool —
+        the previous session's mirror, recycled — so a steady session
+        allocates no full-size arrays."""
         c = self.chunk
-        f2 = np.zeros_like(self._host_f)
+        f2, i2 = self._scratch_f, self._scratch_i
+        if f2 is None or f2.size != cf * c:
+            f2 = np.zeros(cf * c, np.float32)
+        else:
+            f2[fbuf.size:] = 0.0
+        if i2 is None or i2.size != ci * c:
+            i2 = np.zeros(ci * c, np.int32)
+        else:
+            i2[ibuf.size:] = 0
+        self._scratch_f = self._scratch_i = None
         f2[:fbuf.size] = fbuf
-        i2 = np.zeros_like(self._host_i)
         i2[:ibuf.size] = ibuf
         df = np.nonzero((f2.reshape(cf, c)
                          != self._host_f.reshape(cf, c)).any(axis=1))[0]
         di = np.nonzero((i2.reshape(ci, c)
                          != self._host_i.reshape(ci, c)).any(axis=1))[0]
-        self.last_shipped_chunks = int(df.size + di.size)
         return f2, i2, df, di
+
+    def _commit_mirror(self, f2, i2) -> None:
+        """Adopt the diffed buffers as the new mirror; the old mirror
+        becomes next session's diff scratch."""
+        self._scratch_f, self._scratch_i = self._host_f, self._host_i
+        self._host_f, self._host_i = f2, i2
 
     def update(self, fbuf: np.ndarray, ibuf: np.ndarray,
                layout) -> Tuple[object, object]:
@@ -130,8 +223,19 @@ class PackedDeviceCache:
             self.reset()
             raise
         self._dev_f, self._dev_i = new_f, new_i
-        self._host_f, self._host_i = f2, i2
+        self._commit_mirror(f2, i2)
+        self._account(df.size + di.size,
+                      self._scatter_wire_bytes(df, di), full=False)
         return self._dev_f, self._dev_i
+
+    def _scatter_wire_bytes(self, df, di) -> int:
+        """Wire bytes of the separate-scatter path: each dirty set is
+        padded to a power of two (padded chunks repeat real content but
+        still cross the wire)."""
+        c = self.chunk
+        nf = _pow2_bucket(df.size) if df.size else 0
+        ni = _pow2_bucket(di.size) if di.size else 0
+        return (nf + ni) * c * 4 + (nf + ni) * 4
 
     @staticmethod
     def _apply(dev, idx, host2d):
@@ -165,7 +269,8 @@ class PackedDeviceCache:
           FUSED_SLOTS dirty chunks: feed solve_allocate_delta, which
           scatters inside the solve dispatch; the caller must commit()
           the returned (donated) buffers, and on a dispatch failure call
-          reset() so the next session re-ships in full.
+          invalidate() so the next session re-ships the chunked buffers
+          in full (reset() only if the host mirror itself is suspect).
         - ("updated", (f2d, i2d)) — more dirty chunks than FUSED_SLOTS:
           the scatters were applied here (reusing the diff already
           computed), feed the non-fused solve_allocate_packed2d.
@@ -189,6 +294,13 @@ class PackedDeviceCache:
                     self._host_i.reshape(ci, c)[0], (k, c)).copy())
 
         f2, i2, df, di = self._diff(fbuf, ibuf, cf, ci)
+        if df.size == 0 and di.size == 0:
+            # unchanged snapshot: solve straight off the resident buffers
+            # (non-donating packed2d) — zero wire bytes instead of a
+            # no-op fused payload of FUSED_SLOTS chunks
+            self._scratch_f, self._scratch_i = f2, i2
+            self._account(0, 0, full=False)
+            return "updated", (self._dev_f, self._dev_i)
         if int(df.size) > k or int(di.size) > k:
             # too many dirty chunks for the fused variant: apply the
             # scatters now (reusing this diff) and let the caller run the
@@ -200,15 +312,21 @@ class PackedDeviceCache:
                 self.reset()
                 raise
             self._dev_f, self._dev_i = new_f, new_i
-            self._host_f, self._host_i = f2, i2
+            self._commit_mirror(f2, i2)
+            self._account(df.size + di.size,
+                          self._scatter_wire_bytes(df, di), full=False)
             return "updated", (self._dev_f, self._dev_i)
         f_idx = self._pad_idx(df, k)
         i_idx = self._pad_idx(di, k)
-        self._host_f, self._host_i = f2, i2
-        return "fused", (
-            self._dev_f, self._dev_i,
-            f_idx, f2.reshape(cf, c)[f_idx],
-            i_idx, i2.reshape(ci, c)[i_idx])
+        fv = f2.reshape(cf, c)[f_idx]
+        iv = i2.reshape(ci, c)[i_idx]
+        self._commit_mirror(f2, i2)
+        # fused wire cost: both value blocks always ship k chunks (the
+        # fixed jit signature), plus the two index vectors
+        self._account(df.size + di.size,
+                      fv.nbytes + iv.nbytes + f_idx.nbytes + i_idx.nbytes,
+                      full=False)
+        return "fused", (self._dev_f, self._dev_i, f_idx, fv, i_idx, iv)
 
     @staticmethod
     def _pad_idx(idx: np.ndarray, k: int) -> np.ndarray:
@@ -228,8 +346,26 @@ class PackedDeviceCache:
     # small arrays ([N] node_static dominates, ~8 KB at 2k nodes) that
     # almost never change between cycles — re-uploading them every
     # dispatch wastes tunnel bandwidth on the critical path. Cache the
-    # device copies and re-put only when the content bytes change.
+    # device copies and re-put only when the content bytes change, when a
+    # suspect flag (collect failure) finds a device copy actually dead,
+    # or after a hard reset.
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _params_alive(dev_params: Optional[dict]) -> bool:
+        """Whether every pinned device array still holds live buffers.
+        Donation never touches these, so after a collect failure they are
+        normally intact; an actual device restart deletes them."""
+        if not dev_params:
+            return False
+        try:
+            for v in dev_params.values():
+                is_deleted = getattr(v, "is_deleted", None)
+                if is_deleted is not None and is_deleted():
+                    return False
+        except Exception:  # noqa: BLE001 — treat any doubt as dead
+            return False
+        return True
 
     def params_device(self, params: dict) -> dict:
         import jax
@@ -244,9 +380,17 @@ class PackedDeviceCache:
                                repr(a.shape).encode(), a.tobytes())) + b"\1"
 
         blob = b"".join(_ent(k, v) for k, v in sorted(params.items()))
-        if blob == getattr(self, "_params_blob", None):
-            return self._params_dev
+        if blob == self._params_blob:
+            if not self._params_suspect:
+                return self._params_dev
+            # re-validate the pinned copies after a collect failure:
+            # content unchanged AND buffers alive -> keep them resident
+            if self._params_alive(self._params_dev):
+                self._params_suspect = False
+                return self._params_dev
         self._params_dev = {k: jax.device_put(np.asarray(v))
                             for k, v in params.items()}
         self._params_blob = blob
+        self._params_suspect = False
+        self.params_repins += 1
         return self._params_dev

@@ -207,10 +207,18 @@ def solver_cache_size() -> int:
     counts every jit, including trivial ops)."""
     from . import solver as _s
 
+    fns = [_s.solve_allocate, _s.solve_allocate_sequential,
+           _s.solve_allocate_packed, _s.solve_allocate_packed2d,
+           _s.solve_allocate_delta]
+    try:
+        # the sharded entry counts too: sharded-mode sessions dispatch it
+        # and its compiles are exactly as much a session-thread stall
+        from ..parallel import sharded_solver as _ss
+        fns.append(_ss.solve_allocate_sharded_packed2d)
+    except Exception:  # noqa: BLE001 — parallel stack unavailable
+        pass
     n = 0
-    for fn in (_s.solve_allocate, _s.solve_allocate_sequential,
-               _s.solve_allocate_packed, _s.solve_allocate_packed2d,
-               _s.solve_allocate_delta):
+    for fn in fns:
         try:
             n += fn._cache_size()
         except Exception:  # noqa: BLE001 — private API drifted

@@ -22,10 +22,12 @@ Three coordinated pieces plus the harness that proves them:
 from .breaker import CircuitBreaker
 from .faultinject import FaultError, FaultInjector, faults
 from .recovery import BindIntentJournal, reconcile_bind_intents
+from .transient import TRANSIENT_MARKERS, is_transient, retry_transient
 from .watchdog import ActionTimeout, ActionWatchdog
 
 __all__ = [
     "ActionTimeout", "ActionWatchdog", "BindIntentJournal",
     "CircuitBreaker", "FaultError", "FaultInjector", "faults",
-    "reconcile_bind_intents",
+    "reconcile_bind_intents", "TRANSIENT_MARKERS", "is_transient",
+    "retry_transient",
 ]

@@ -244,6 +244,24 @@ class Scheduler:
         timing["session_compiles"] = float(c - prev_c)
         timing["session_compile_s"] = s - prev_s
         timing["compile_cache_hits"] = float(watcher.cache_hits)
+        dc = getattr(self.cache, "device_cache", None)
+        if dc is not None and getattr(dc, "sessions", 0):
+            # device-resident arena accounting (ops.device_cache): wire
+            # bytes per steady session and the hit rate are the two
+            # numbers that say whether the RTT-floor amortization is
+            # actually engaged (per-cycle bytes come from the allocate
+            # action's timing; these are the arena's cumulative view)
+            timing["arena_hit_rate"] = dc.arena_hit_rate
+            metrics.arena_bytes_shipped.set(
+                timing.get("arena_bytes_shipped", dc.last_shipped_bytes))
+            metrics.arena_bytes_shipped_total.set(dc.total_shipped_bytes)
+            metrics.arena_hit_rate.set(dc.arena_hit_rate)
+            metrics.arena_sessions_total.set(
+                dc.delta_sessions, labels={"outcome": "delta"})
+            metrics.arena_sessions_total.set(
+                dc.full_ships, labels={"outcome": "full"})
+            metrics.arena_invalidations_total.set(dc.invalidations)
+            metrics.arena_params_repins_total.set(dc.params_repins)
         pw = getattr(self.cache, "prewarmer", None)
         if pw is not None:
             timing["prewarm_completions"] = float(pw.completions)
