@@ -666,7 +666,10 @@ def full_cycle():
 
 def dict_timing(sched):
     t = getattr(sched, "last_cycle_timing", None)
-    return {k: round(v, 2) for k, v in (t or {}).items()}
+    # timing carries non-numeric diagnostics too (arena_mode str,
+    # arena_shard_bytes list) — round only the scalars
+    return {k: (round(v, 2) if isinstance(v, (int, float)) else v)
+            for k, v in (t or {}).items()}
 
 
 def sharded_path_compare(single_device_ms):
@@ -746,6 +749,266 @@ def sharded_path_compare(single_device_ms):
         "sharded_over_single": round(ratio, 3) if ratio else None,
         "placed": placed,
     })
+    return out
+
+
+def _synth_snapshot(n_tasks: int, n_nodes: int, n_queues: int = 3,
+                    tasks_per_job: int = 97, seed: int = 7):
+    """A SnapshotArrays built directly from numpy (no 100k python pod
+    objects): the beyond-one-chip bench exercises the arena + sharded
+    solve data path, whose inputs are exactly these padded arrays. Sized
+    unsaturated so every gang places in one fixpoint iteration and the
+    measured time is the steady solve, not a pathological revert storm."""
+    from volcano_tpu.api.resource import ResourceVocab
+    from volcano_tpu.ops import SnapshotArrays
+
+    rng = np.random.default_rng(seed)
+    T, N = n_tasks, n_nodes
+    R = 2
+    J = max(T // tasks_per_job + (1 if T % tasks_per_job else 0), 1)
+    arr = SnapshotArrays(vocab=ResourceVocab())
+    arr.task_init_req = np.zeros((T, R), np.float32)
+    arr.task_job = np.zeros(T, np.int32)
+    arr.task_rank = np.arange(T, dtype=np.int32)
+    arr.task_sig = np.zeros(T, np.int32)
+    arr.task_counts_ready = np.ones(T, bool)
+    arr.task_valid = np.ones(T, bool)
+    job_min = np.zeros(J, np.int32)
+    for j in range(J):
+        lo, hi = j * tasks_per_job, min((j + 1) * tasks_per_job, T)
+        req = (float(rng.integers(1, 4)) * 1000.0,
+               float(rng.integers(1, 5)) * (1 << 30))
+        arr.task_init_req[lo:hi] = req
+        arr.task_job[lo:hi] = j
+        job_min[j] = hi - lo
+    arr.task_req = arr.task_init_req.copy()
+    arr.job_min = job_min
+    arr.job_ready_base = np.zeros(J, np.int32)
+    arr.job_queue = (np.arange(J) % n_queues).astype(np.int32)
+    arr.job_valid = np.ones(J, bool)
+    arr.job_drf_allocated = np.zeros((J, R), np.float32)
+    arr.drf_total = np.zeros(R, np.float32)
+    arr.job_drf_prerank = np.zeros(J, np.int32)
+    idle = np.zeros((N, R), np.float32)
+    # capacity ~3x demand: binpack concentrates, nothing reverts
+    per_node_cpu = max(3.0 * np.sum(arr.task_init_req[:, 0]) / N, 8000.0)
+    idle[:, 0] = np.float32(per_node_cpu)
+    idle[:, 1] = np.float32(256.0 * (1 << 30))
+    arr.node_idle = idle
+    arr.node_extra_future = np.zeros((N, R), np.float32)
+    arr.node_used = np.zeros((N, R), np.float32)
+    arr.node_alloc = idle.copy()
+    arr.node_npods = np.zeros(N, np.int32)
+    arr.node_max_pods = np.full(N, 1 << 20, np.int32)
+    arr.node_valid = np.ones(N, bool)
+    arr.sig_masks = np.ones((1, N), bool)
+    qw = np.arange(1, n_queues + 1, dtype=np.float32)
+    arr.queue_weight = qw
+    arr.queue_capability = np.full((n_queues, R), np.inf, np.float32)
+    arr.queue_allocated = np.zeros((n_queues, R), np.float32)
+    qreq = np.zeros((n_queues, R), np.float64)
+    for j in range(J):
+        lo, hi = j * tasks_per_job, min((j + 1) * tasks_per_job, T)
+        qreq[arr.job_queue[j]] += arr.task_init_req[lo:hi].sum(axis=0)
+    arr.queue_request = qreq.astype(np.float32)
+    arr.thresholds = np.array([10.0, 1.0], np.float32)
+    arr.scalar_dim_mask = np.zeros(R, bool)
+    return arr
+
+
+def _decision_digest(*arrays) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def sharded_scale(n_tasks: int = 100_000, n_nodes: int = 10_000,
+                  pipe_sessions: int = 8, churn_tasks: int = 256,
+                  churn_nodes: int = 64, sub_tasks: int = 2_048,
+                  sub_nodes: int = 1_024):
+    """The beyond-one-chip headline (``sharded_100k_10k``): 100k tasks x
+    10k nodes solved with the node axis sharded over the device mesh —
+    padded buffers that deliberately exceed one chip's working set — via
+    the SHARDED device-resident arena (ops.device_cache.
+    ShardedDeviceCache) and the three-phase session pipeline. Reports:
+
+    - pipelined steady-state wall p50 across churned sessions (session
+      s+1's delta ships while session s solves on the mesh);
+    - wire bytes shipped PER SHARD per steady session + arena hit rate,
+      and a zero-dirty session asserted to ship 0 bytes to every shard;
+    - a sub-scale digest cross-check: the same problem solved by the
+      sharded arena on the full mesh and by the D=1 packed path must be
+      decision-identical bit for bit (the host-oracle leg of the
+      cross-check runs in ``sim_quality``, whose host/device/sharded
+      arms share one seeded workload).
+
+    Degradation contract: on a single-device host the full-scale run is
+    not attempted (one chip cannot hold it — that is the point); the
+    artifact carries the sub-scale cross-check plus an ``error`` field
+    and ``ok=false``, never a crash (BENCH_r05's regression shape).
+    """
+    import jax
+
+    from volcano_tpu.ops.device_cache import (
+        PackedDeviceCache, ShardedDeviceCache,
+    )
+    from volcano_tpu.ops.pipeline import SessionPipeline, start_readback
+    from volcano_tpu.ops.solver import decode_compact, \
+        solve_allocate_packed2d
+    from volcano_tpu.parallel import arena_mesh, solve_allocate_sharded_arena
+    from volcano_tpu.resilience.transient import retry_transient
+
+    mesh = arena_mesh()
+    D = int(mesh.devices.size)
+    out = {
+        "tasks": n_tasks, "nodes": n_nodes,
+        "devices": len(jax.devices()), "mesh_devices": D,
+        "ok": False,
+    }
+    kw = dict(herd_mode="pack", score_families=("binpack",),
+              use_queue_cap=True)
+
+    def _scale_params(a):
+        return {
+            "binpack_weight": np.float32(1.0),
+            "binpack_res_weights": np.ones(a.R, np.float32),
+            "least_req_weight": np.float32(0.0),
+            "most_req_weight": np.float32(0.0),
+            "balanced_weight": np.float32(0.0),
+            "node_static": np.zeros(a.N, np.float32),
+        }
+
+    # ---- sub-scale digest cross-check (runs at any device count) ----
+    sub = _synth_snapshot(sub_tasks, sub_nodes)
+    fbuf, ibuf, layout = sub.packed()
+    params = _scale_params(sub)
+    sdc_sub = ShardedDeviceCache(mesh)
+    bufs = sdc_sub.update(fbuf, ibuf, layout)
+    r_sh = retry_transient(
+        lambda: solve_allocate_sharded_arena(
+            *bufs, sdc_sub.params_device(params), mesh, **kw),
+        what="sub-scale sharded dispatch")
+    dc = PackedDeviceCache()
+    f2d, i2d = dc.update(fbuf, ibuf, layout)
+    r_pk = solve_allocate_packed2d(f2d, i2d, layout, params, **kw)
+    a_pk, k_pk = decode_compact(np.asarray(r_pk.compact))
+    d_sh = _decision_digest(np.asarray(r_sh.assigned)[:sub_tasks],
+                            np.asarray(r_sh.kind)[:sub_tasks])
+    d_pk = _decision_digest(a_pk[:sub_tasks], k_pk[:sub_tasks])
+    out["subscale_tasks"] = sub_tasks
+    out["subscale_digest_sharded"] = d_sh
+    out["subscale_digest_packed_d1"] = d_pk
+    out["subscale_digest_identical"] = bool(d_sh == d_pk)
+
+    if D < 2:
+        out["error"] = (
+            f"sharded_100k_10k needs a multi-device mesh (have {D} "
+            "device(s)): the full-scale problem does not fit one chip's "
+            "padded buffers by design; sub-scale cross-check recorded")
+        return out
+
+    # ---- full-scale pipelined steady state over the sharded arena ----
+    arr = _synth_snapshot(n_tasks, n_nodes)
+    params = _scale_params(arr)
+    sdc = ShardedDeviceCache(mesh)
+
+    def churn(s):
+        """Dirty one contiguous task band (a job wave re-sizing: the
+        replicated delta) and one contiguous node band (idle drift on a
+        rack: the per-shard delta) — the headline's ~1% churn shape,
+        contiguous like real job blocks so the dirty set stays a few
+        chunks, not a chunk-per-row smear."""
+        lo = (s * churn_tasks) % max(n_tasks - churn_tasks, 1)
+        ti = np.arange(lo, lo + churn_tasks)
+        arr.task_init_req[ti, 0] = np.float32((1.0 + (s % 3)) * 1000.0)
+        arr.task_req[ti] = arr.task_init_req[ti]
+        nlo = (s * churn_nodes) % max(n_nodes - churn_nodes, 1)
+        ni = np.arange(nlo, nlo + churn_nodes)
+        arr.node_idle[ni, 0] = arr.node_alloc[ni, 0] - np.float32(
+            1000.0 * (1 + s % 4))
+
+    def session(tag, pipe):
+        fb, ib, lay = arr.packed()
+        bufs = sdc.update(fb, ib, lay)
+        pd = sdc.params_device(params)
+        sbytes = (list(sdc.last_shard_bytes),
+                  int(sdc.last_shipped_bytes))
+
+        def dispatch():
+            r = retry_transient(
+                lambda: solve_allocate_sharded_arena(
+                    *bufs, pd, mesh, **kw),
+                what="sharded scale dispatch")
+            start_readback(r.assigned, r.kind)
+            return r
+
+        def collect(r):
+            return np.asarray(r.assigned), np.asarray(r.kind)
+
+        return pipe.submit(tag, dispatch, collect), sbytes
+
+    try:
+        # warm (compile) + settle
+        pipe = SessionPipeline(depth=2)
+        t_warm = time.perf_counter()
+        t0, _ = session(-1, pipe)
+        a0, _k0 = t0.result(1800)
+        out["warm_s"] = round(time.perf_counter() - t_warm, 1)
+        out["placed_warm"] = int((a0[:n_tasks] >= 0).sum())
+
+        # zero-dirty session: unchanged snapshot -> 0 bytes to every shard
+        tz, (zbytes, _zwire) = session(-2, pipe)
+        tz.result(600)
+        out["zero_dirty_shard_bytes"] = [int(b) for b in zbytes]
+        out["zero_dirty_ok"] = not any(zbytes)
+
+        shard_bytes, wire_bytes = [], []
+        tickets = []
+        t_pipe0 = time.perf_counter()
+        for s in range(pipe_sessions):
+            churn(s)
+            t, (sb, wb) = session(s, pipe)
+            tickets.append(t)
+            shard_bytes.append(sb)
+            wire_bytes.append(wb)
+        pipe.drain(timeout=1800)
+        wall_ms = (time.perf_counter() - t_pipe0) * 1e3
+        out["pipeline_overlap_pairs"] = pipe.overlap_pairs()
+        pipe.close()
+        cts = [t.t_collected for t in tickets]
+        gaps = (np.diff(cts)[1:] * 1e3) if len(cts) > 2 else \
+            np.asarray([wall_ms / max(pipe_sessions, 1)])
+        a_last, _ = tickets[-1].result()
+        placed = int((a_last[:n_tasks] >= 0).sum())
+        per_shard = np.asarray(shard_bytes, np.float64)   # [S, D]
+        full = sdc.full_upload_bytes()
+        wire_mean = float(np.mean(wire_bytes))
+        out.update({
+            "steady_wall_p50_ms": round(float(np.percentile(gaps, 50)), 2),
+            **spread_fields("steady_wall", gaps),
+            "pipeline_sessions": pipe_sessions,
+            "pipeline_wall_ms_total": round(wall_ms, 2),
+            # per-shard view: what each device received (its node chunks
+            # + its copy of the replicated task/job delta)
+            "bytes_per_shard_per_session":
+                [int(x) for x in per_shard.mean(axis=0)],
+            # host-wire view: the arena accounting (replicated delta
+            # counted once — the runtime fans it out)
+            "bytes_shipped_per_session": int(wire_mean),
+            "bytes_shipped_pct_of_full": round(
+                100.0 * wire_mean / max(full, 1), 2),
+            "full_upload_bytes": int(full),
+            "arena_hit_rate": round(sdc.arena_hit_rate, 3),
+            "placed": placed,
+        })
+        out["ok"] = bool(
+            out["subscale_digest_identical"] and out["zero_dirty_ok"]
+            and placed > 0 and sdc.arena_hit_rate > 0.5)
+    except Exception as e:  # noqa: BLE001 — partial artifact, never abort
+        out["error"] = f"{type(e).__name__}: {e}".strip()[:500]
     return out
 
 
@@ -1623,6 +1886,7 @@ def _main_inner() -> dict:
         ("config5_hier_5k_1k", config5_hierarchical),
         ("sharded_path_10k_2k",
          lambda: sharded_path_compare(single_dev_ms)),
+        ("sharded_100k_10k", sharded_scale),
         ("full_cycle_10k_2k", full_cycle),
         ("steady_churn_1p5k_400", steady_churn),
         ("chaos_churn_50", chaos_churn),

@@ -280,6 +280,9 @@ class AllocateAction(Action):
 
         dc = getattr(ssn, "device_cache", None)
         sidecar = getattr(ssn, "sidecar", None)
+        # which arena a device fault must invalidate: the packed cache by
+        # default, the sharded arena when this session dispatched there
+        fault_dc = dc
         try:
             # device-path circuit-breaker scope: anything that throws out
             # of the dispatch (XLA runtime error, OOM, dead sidecar, an
@@ -292,64 +295,80 @@ class AllocateAction(Action):
                     use_queue_cap=use_queue_cap,
                     work_conserving=work_conserving)
             elif sharded:
-                # mode: sharded — the shard_map solver on a 1-device mesh
-                # over the same packed device-resident form the
-                # single-device dispatch uses. The sim's scheduling-quality
-                # A/B runs this arm against the host oracle and the plain
-                # device solver on the same seed; multi-chip deployments
-                # get the identical code path with a wider mesh. The
-                # dispatch gets one transient-transport retry (a dropped
-                # remote_compile stream re-sends instead of burning a
-                # breaker failure — BENCH_r05's abort mode), and anything
-                # that still fails degrades through the same breaker +
-                # host-oracle ladder as the packed path.
-                import jax
-
+                # mode: sharded — the node-axis shard_map solver over the
+                # SHARDED device-resident arena (ShardedDeviceCache):
+                # node-axis chunks live per mesh device, task/job chunks
+                # are replicated once per device, and a steady session
+                # ships dirty chunks only to the shard(s) owning them
+                # (a zero-dirty session dispatches straight off the
+                # resident shards, 0 bytes). At D=1 the mesh degrades to
+                # the packed arena's shape with a collective-free program;
+                # multi-chip deployments get the identical code path with
+                # a wider mesh. The dispatch keeps the packed path's whole
+                # protection ladder: one transient-transport retry (a
+                # dropped remote_compile stream re-sends instead of
+                # burning a breaker failure — BENCH_r05's abort mode),
+                # the circuit breaker + host-oracle fallback around this
+                # block, and the async-readback overlap below.
                 from ..parallel import (
-                    make_mesh, solve_allocate_sharded_packed2d,
+                    arena_mesh, solve_allocate_sharded_arena,
                 )
                 from ..resilience.transient import retry_transient
+                t1 = _time.perf_counter()
                 fbuf, ibuf, layout = arr.packed()
-                if dc is not None:
-                    f2d, i2d = dc.update(fbuf, ibuf, layout)
-                    params = dc.params_device(params)
-                    timing["arena_bytes_shipped"] = \
-                        float(dc.last_shipped_bytes)
-                    timing["arena_full_ship"] = float(dc.last_full_ship)
-                else:
-                    from ..ops.device_cache import PackedDeviceCache
-                    f2d, i2d = PackedDeviceCache().update(fbuf, ibuf, layout)
-                    params = {k: jax.device_put(np.asarray(v))
-                              for k, v in params.items()}
-                mesh = make_mesh(jax.devices()[:1])
+                timing["pack_ms"] = (_time.perf_counter() - t1) * 1e3
+                sdc = getattr(ssn, "sharded_device_cache", None)
+                if sdc is None:
+                    from ..ops.device_cache import ShardedDeviceCache
+                    sdc = ShardedDeviceCache(arena_mesh())
+                    ssn.sharded_device_cache = sdc
+                    if getattr(ssn, "cache", None) is not None:
+                        # persist across sessions: an arena is only an
+                        # arena if it outlives the session that built it
+                        ssn.cache.sharded_device_cache = sdc
+                fault_dc = sdc
+                mesh = sdc.mesh
+                t1 = _time.perf_counter()
+                bufs = sdc.update(fbuf, ibuf, layout)
+                params = sdc.params_device(params)
+                timing["delta_plan_ms"] = (_time.perf_counter() - t1) * 1e3
+                timing["delta_chunks"] = float(sdc.last_shipped_chunks)
+                timing["arena_mode"] = "sharded"
+                timing["arena_bytes_shipped"] = \
+                    float(sdc.last_shipped_bytes)
+                timing["arena_full_ship"] = float(sdc.last_full_ship)
+                timing["arena_shard_bytes"] = \
+                    [float(b) for b in sdc.last_shard_bytes]
                 pw = getattr(ssn, "prewarmer", None)
                 if pw is not None and pw.mesh is None:
-                    # sharded sessions must pre-warm (and persistent-cache)
-                    # the sharded solve variants too, not just packed2d
+                    # sharded sessions must pre-warm (and persistent-
+                    # cache) the sharded arena variants too, not just
+                    # packed2d
                     pw.mesh = mesh
-                if dc is not None:
-                    # flags snapshot so the bucket prewarmer can predict
-                    # this mode's next-bucket variants (the sharded warm
-                    # rides the same observe path as packed2d)
-                    dc.last_solve_flags = dict(
-                        layout=layout, herd_mode=herd,
-                        score_families=families,
-                        use_queue_cap=use_queue_cap,
-                        use_drf_order=use_drf_order,
-                        use_hdrf_order=use_hdrf_order,
-                        work_conserving=work_conserving)
+                # flags snapshot so the bucket prewarmer can predict this
+                # mode's next-bucket variants
+                sdc.last_solve_flags = dict(
+                    layout=layout, herd_mode=herd,
+                    score_families=families,
+                    use_queue_cap=use_queue_cap,
+                    use_drf_order=use_drf_order,
+                    use_hdrf_order=use_hdrf_order,
+                    work_conserving=work_conserving)
+                t1 = _time.perf_counter()
                 r = retry_transient(
-                    lambda: solve_allocate_sharded_packed2d(
-                        f2d, i2d, layout, params, mesh, herd_mode=herd,
+                    lambda: solve_allocate_sharded_arena(
+                        *bufs, params, mesh, herd_mode=herd,
                         score_families=families,
                         use_queue_cap=use_queue_cap,
                         use_drf_order=use_drf_order,
                         use_hdrf_order=use_hdrf_order),
                     what="sharded solver dispatch")
-                # SolveResult.compact is not produced by the sharded
-                # kernel; collect assigned/kind directly (sidecar shape)
-                assigned = np.asarray(r.assigned)
-                kind = np.asarray(r.kind)
+                timing["dispatch_ms"] = (_time.perf_counter() - t1) * 1e3
+                # the sharded kernel produces no compact readback:
+                # assigned/kind stay DEVICE futures here and collect in
+                # the res-is-None branch below, after the overlap window
+                assigned = r.assigned
+                kind = r.kind
                 res = None
             elif sidecar is not None:
                 # process boundary: ship the packed snapshot to the solver
@@ -391,6 +410,7 @@ class AllocateAction(Action):
                 timing["delta_plan_ms"] = (_time.perf_counter() - t1) * 1e3
                 timing["delta_chunks"] = float(dc.last_shipped_chunks)
                 timing["delta_fused"] = float(kind_ == "fused")
+                timing["arena_mode"] = "packed"
                 timing["arena_bytes_shipped"] = float(dc.last_shipped_bytes)
                 timing["arena_full_ship"] = float(dc.last_full_ship)
                 t1 = _time.perf_counter()
@@ -433,7 +453,7 @@ class AllocateAction(Action):
         except Exception:
             log.exception("solver dispatch failed; resetting the device "
                           "cache and falling back to the host loop")
-            self._device_fault_fallback(ssn, dc, timing, breaker)
+            self._device_fault_fallback(ssn, fault_dc, timing, breaker)
             return
         # ------------------------------------------------------------------
         # dispatch/collect split: the jitted solve above is an ASYNC
@@ -450,21 +470,28 @@ class AllocateAction(Action):
         pipelined = bool(getattr(ssn, "pipeline_solver", True))
         node_names = None
         statements = None
-        if res is not None and pipelined:
+        prewarmed = False
+        if pipelined and (res is not None or sharded):
             t1 = _time.perf_counter()
             # previous-phase readback starts NOW: begin the device->host
             # result transfer asynchronously so the wire RTT overlaps the
             # solve tail and the replay-prep below instead of being paid
-            # serially when the collect blocks (ops.pipeline)
+            # serially when the collect blocks (ops.pipeline). The
+            # sharded kernel has no compact form; its assigned/kind
+            # futures prefetch the same way.
             from ..ops.pipeline import start_readback
-            start_readback(res.compact, res.assigned, res.kind)
+            if res is not None:
+                start_readback(res.compact, res.assigned, res.kind)
+            else:
+                start_readback(assigned, kind)
             node_names = [n.name for n in arr.nodes_list]
             # Statement construction is pure (no session registration
             # until ops are recorded), so the replay's per-job statements
             # can be built before the results exist
             statements = [ssn.statement(defer_events=True)
                           for _ in job_order]
-            self._observe_prewarm(ssn, arr, dc)
+            self._observe_prewarm(ssn, arr, fault_dc)
+            prewarmed = True
             import jax
             if jax.default_backend() != "cpu":
                 # young-gen GC only when the solve runs on a real
@@ -499,7 +526,7 @@ class AllocateAction(Action):
                 # one slow cycle, not a scheduling gap
                 log.exception("solver collect failed; resetting device "
                               "cache and falling back to the host loop")
-                self._device_fault_fallback(ssn, dc, timing, breaker)
+                self._device_fault_fallback(ssn, fault_dc, timing, breaker)
                 return
             timing["readback_ms"] = (_time.perf_counter() - t1) * 1e3
             if not pipelined:
@@ -508,21 +535,29 @@ class AllocateAction(Action):
                 # compile-stall protection
                 self._observe_prewarm(ssn, arr, dc)
         else:
-            # sharded/sidecar path: assignments are already host arrays
+            # sharded/sidecar path: block on the assigned/kind readback
+            # (the sidecar already returned host arrays; the sharded
+            # overlap window above began the async device->host transfer,
+            # so this collect pays only the remaining tail)
+            t1 = _time.perf_counter()
             try:
-                self._check_solver_output(np.asarray(assigned),
-                                          np.asarray(kind),
+                assigned = np.asarray(assigned)
+                kind = np.asarray(kind)
+                self._check_solver_output(assigned, kind,
                                           len(tasks_in_order),
                                           len(arr.nodes_list))
             except Exception:
                 log.exception("sharded/sidecar solver output failed "
                               "validation; falling back to the host loop")
-                self._device_fault_fallback(ssn, dc, timing, breaker)
+                self._device_fault_fallback(ssn, fault_dc, timing, breaker)
                 return
-            # these modes skip the dispatch/collect overlap window above,
-            # so the occupancy check runs here — a sharded session's
-            # bucket crossing must pre-warm its own (sharded) variants
-            self._observe_prewarm(ssn, arr, dc)
+            timing["readback_ms"] = (_time.perf_counter() - t1) * 1e3
+            if not prewarmed:
+                # the sidecar (and serial sharded) path skipped the
+                # overlap window above, so the occupancy check runs here
+                # — a sharded session's bucket crossing must pre-warm its
+                # own (sharded) variants
+                self._observe_prewarm(ssn, arr, fault_dc)
         if breaker is not None:
             # a full dispatch+collect round-trip with sane output: the
             # device path is healthy (closes a half-open breaker)

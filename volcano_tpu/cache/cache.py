@@ -290,6 +290,17 @@ class SchedulerCache:
                                      "reclaim": FlattenCache()}
         # device-resident packed solver buffers (delta-shipped per session)
         self.device_cache = PackedDeviceCache()
+        # node-axis sharded arena (ops.device_cache.ShardedDeviceCache):
+        # built lazily by the allocate action's first sharded session —
+        # constructing it eagerly would initialize jax/the mesh for
+        # control planes that never dispatch sharded
+        self.sharded_device_cache = None
+        # --solver-mode preference consumed by Action.resolve_mode: None/
+        # "packed" keep per-action conf routing, "sharded" dispatches the
+        # shard_map solver, "auto" shards when the padded problem exceeds
+        # sharded_byte_budget bytes per device (0 = never auto-shard)
+        self.solver_mode = None
+        self.sharded_byte_budget = 0
         # optional solver-sidecar client (parallel.sidecar.SidecarSolver):
         # when set, allocate ships snapshots to the solver process instead
         # of running the kernel in-process

@@ -318,7 +318,9 @@ def sim_cmd(args, cluster: ClusterStore) -> str:
     if args.verify:
         rep = sim_replay.verify(args.verify, workload=workload,
                                 cycles=args.cycles, mode=args.mode,
-                                drain=args.drain)
+                                drain=args.drain,
+                                solver_mode=args.solver_mode,
+                                sharded_byte_budget=args.sharded_byte_budget)
         status = "replay OK (byte-identical)" if rep["ok"] \
             else "replay DIVERGED"
         out = [f"{status}: {rep['cycles']} cycles, digest {rep['digest']}"]
@@ -328,7 +330,9 @@ def sim_cmd(args, cluster: ClusterStore) -> str:
 
     result = sim_replay.run_sim(workload=workload, cycles=args.cycles,
                                 mode=args.mode, drain=args.drain,
-                                record_path=args.record)
+                                record_path=args.record,
+                                solver_mode=args.solver_mode,
+                                sharded_byte_budget=args.sharded_byte_budget)
     sc = result.score
     out = [
         f"sim: {sc['cycles']} cycles, mode={args.mode}, seed={args.seed}",
@@ -408,6 +412,17 @@ def build_parser() -> argparse.ArgumentParser:
                     "(record/replay/score scheduling quality)")
     simp.add_argument("--cycles", type=int, default=100)
     simp.add_argument("--seed", type=int, default=0)
+    simp.add_argument("--solver-mode", default=None,
+                      choices=["packed", "sharded", "auto"],
+                      help="device-solver routing: packed = single-device "
+                           "arena, sharded = node-axis shard_map arena, "
+                           "auto = shard when the padded problem exceeds "
+                           "--sharded-byte-budget bytes per device "
+                           "(applies when --mode is left at its default)")
+    simp.add_argument("--sharded-byte-budget", type=int,
+                      default=256 * 1024 * 1024,
+                      help="per-device resident-state budget for "
+                           "--solver-mode auto (bytes; default 256 MiB)")
     simp.add_argument("--mode", default="solver",
                       choices=["solver", "host", "sequential", "sharded"])
     simp.add_argument("--nodes", type=int, default=8)

@@ -20,17 +20,57 @@ class Action:
 
     def resolve_mode(self, ssn, default: str = "solver") -> str:
         """Execution mode for this action: per-action YAML configuration
-        ('mode' argument), overridden to 'host' when a plugin demands
-        host-only state tracking (GPU sharing card assignment)."""
+        ('mode' argument), then the deployment-level --solver-mode
+        preference when the conf left the mode implicit, overridden to
+        'host' when a plugin demands host-only state tracking (GPU
+        sharing card assignment)."""
         from .arguments import Arguments
 
         mode = default
+        configured = False
         for conf in ssn.configurations:
             if conf.name == self.name():
-                mode = Arguments(conf.arguments).get("mode", default)
+                m = Arguments(conf.arguments).get("mode", None)
+                if m is not None:
+                    mode, configured = m, True
+                else:
+                    mode = default
+        if not configured:
+            pref = getattr(ssn, "solver_mode", None)
+            if pref in ("packed", "sharded", "auto"):
+                mode = self._preferred_mode(ssn, pref, default)
         if ssn.solver_options.get("force_host_allocate"):
             mode = "host"
         return mode
+
+    @staticmethod
+    def _preferred_mode(ssn, pref: str, default: str) -> str:
+        """The --solver-mode decision rule. 'packed' keeps the
+        single-device solver; 'sharded' always dispatches the node-axis
+        shard_map solver over the sharded arena; 'auto' picks sharded
+        exactly when the padded problem's device-resident footprint —
+        one full upload at the current layout, measured from whichever
+        arena served the last session — exceeds the per-device byte
+        budget (``--sharded-byte-budget``): when one chip would have to
+        hold more resident solver state than the budget allows, shard it
+        over the mesh. The first session (no layout measured yet) and a
+        zero/unset budget run packed."""
+        if pref == "sharded":
+            return "sharded"
+        if pref == "packed":
+            return default
+        budget = int(getattr(ssn, "sharded_byte_budget", 0) or 0)
+        if budget <= 0:
+            return default
+        est = 0
+        for attr in ("device_cache", "sharded_device_cache"):
+            c = getattr(ssn, attr, None)
+            if c is not None:
+                try:
+                    est = max(est, c.full_upload_bytes())
+                except Exception:  # noqa: BLE001 — sizing is advisory
+                    pass
+        return "sharded" if est > budget else default
 
 
 class Plugin:

@@ -110,6 +110,13 @@ class Session:
         self.evict_flatten_caches = getattr(cache, "evict_flatten_caches",
                                             None) or {}
         self.device_cache = getattr(cache, "device_cache", None)
+        # node-axis sharded arena + --solver-mode routing preference (the
+        # allocate action builds the arena lazily and writes it back to
+        # the cache so it persists across sessions)
+        self.sharded_device_cache = getattr(cache, "sharded_device_cache",
+                                            None)
+        self.solver_mode = getattr(cache, "solver_mode", None)
+        self.sharded_byte_budget = getattr(cache, "sharded_byte_budget", 0)
         self.sidecar = getattr(cache, "sidecar", None)
         # compile-and-dispatch pipeline knobs (ops.precompile): background
         # bucket pre-warm and the allocate action's dispatch/collect

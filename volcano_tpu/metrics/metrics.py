@@ -250,26 +250,37 @@ session_phase_ms = registry.register(Gauge(
 arena_bytes_shipped = registry.register(Gauge(
     "volcano_arena_bytes_shipped",
     "Wire bytes shipped to the device-resident arena by the last "
-    "scheduling session (dirty chunks only in steady state)"))
+    "scheduling session (dirty chunks only in steady state), per solver "
+    "mode (packed = single-device arena, sharded = node-axis mesh arena)",
+    ["mode"]))
 arena_bytes_shipped_total = registry.register(Gauge(
     "volcano_arena_bytes_shipped_total",
-    "Cumulative wire bytes shipped to the device-resident arena"))
+    "Cumulative wire bytes shipped to the device-resident arena, per "
+    "solver mode", ["mode"]))
 arena_hit_rate = registry.register(Gauge(
     "volcano_arena_hit_rate",
     "Fraction of sessions served by a delta against the resident arena "
-    "(1.0 = no full re-ship since the first session)"))
+    "(1.0 = no full re-ship since the first session), per solver mode",
+    ["mode"]))
 arena_sessions_total = registry.register(Gauge(
     "volcano_arena_sessions_total",
     "Arena sessions by outcome (delta = dirty-chunk ship, full = "
-    "full padded-buffer upload)", ["outcome"]))
+    "full padded-buffer upload) and solver mode", ["outcome", "mode"]))
 arena_invalidations_total = registry.register(Gauge(
     "volcano_arena_invalidations_total",
     "Soft arena invalidations after collect failures (next session "
-    "full-ships and re-validates pinned params)"))
+    "full-ships and re-validates pinned params), per solver mode",
+    ["mode"]))
 arena_params_repins_total = registry.register(Gauge(
     "volcano_arena_params_repins_total",
     "Device score-params uploads (content change or failed "
-    "re-validation; steady sessions serve the pinned copy)"))
+    "re-validation; steady sessions serve the pinned copy), per solver "
+    "mode", ["mode"]))
+arena_shard_bytes_shipped = registry.register(Gauge(
+    "volcano_arena_shard_bytes_shipped",
+    "Wire bytes shipped to one mesh shard by the last sharded session "
+    "(node-axis dirty chunks owned by the shard + its copy of the "
+    "replicated task/job delta)", ["shard"]))
 
 # -- resilience metrics (resilience/, scheduler containment, store client) --
 

@@ -13,7 +13,8 @@ _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
          "solve_allocate_sequential", "solve_allocate_packed",
          "solve_allocate_packed2d")
 _LAZY_EVICT = ("EvictResult", "solve_evict")
-_LAZY_DEVCACHE = ("PackedDeviceCache",)
+_LAZY_DEVCACHE = ("PackedDeviceCache", "ShardedDeviceCache",
+                  "split_packed_layout")
 # precompile itself only imports jax lazily (inside functions/threads), but
 # routing it through the lazy hook keeps the import-cost contract uniform
 _LAZY_PRECOMPILE = ("BucketPrewarmer", "CompileWatcher",

@@ -47,6 +47,7 @@ def _pow2_bucket(n: int) -> int:
 
 
 _APPLY = None  # lazily created singleton so the jit caches across sessions
+_APPLY_KEEP = None  # non-donating variant (sharded arena: buffers alias)
 
 
 def _scatter(dev, idx, vals):
@@ -55,6 +56,18 @@ def _scatter(dev, idx, vals):
         import jax
         _APPLY = jax.jit(lambda d, i, v: d.at[i].set(v), donate_argnums=(0,))
     return _APPLY(dev, idx, vals)
+
+
+def _scatter_keep(dev, idx, vals):
+    """Non-donating chunk scatter: the sharded arena's per-device shard
+    buffers are aliased by the previously assembled global array (an
+    in-flight pipelined solve may still read it), so donation would
+    poison a live session's inputs."""
+    global _APPLY_KEEP
+    if _APPLY_KEEP is None:
+        import jax
+        _APPLY_KEEP = jax.jit(lambda d, i, v: d.at[i].set(v))
+    return _APPLY_KEEP(dev, idx, vals)
 
 
 class PackedDeviceCache:
@@ -367,9 +380,15 @@ class PackedDeviceCache:
             return False
         return True
 
-    def params_device(self, params: dict) -> dict:
+    def _put_params(self, params: dict) -> dict:
+        """Device placement for the pinned score params; the sharded
+        arena subclass overrides this to shard node_static along the
+        mesh and replicate the scalars."""
         import jax
 
+        return {k: jax.device_put(np.asarray(v)) for k, v in params.items()}
+
+    def params_device(self, params: dict) -> dict:
         def _ent(k, v):
             # delimited key + dtype + shape + content: without these two
             # distinct params dicts whose concatenated bytes happen to
@@ -388,9 +407,329 @@ class PackedDeviceCache:
             if self._params_alive(self._params_dev):
                 self._params_suspect = False
                 return self._params_dev
-        self._params_dev = {k: jax.device_put(np.asarray(v))
-                            for k, v in params.items()}
+        self._params_dev = self._put_params(params)
         self._params_blob = blob
         self._params_suspect = False
         self.params_repins += 1
         return self._params_dev
+
+
+# ---------------------------------------------------------------------------
+# node-axis-sharded arena: the D>1 steady-state analog of the cache above
+# ---------------------------------------------------------------------------
+
+#: packed keys whose LEADING axis is the node axis — sharded along the
+#: mesh 'n' axis by the sharded arena (parallel.sharded_solver in_specs
+#: use P("n", ...) for exactly these)
+NODE_AXIS_KEYS = frozenset({
+    "node_idle", "node_extra_future", "node_used", "node_alloc",
+    "node_npods", "node_max_pods", "node_valid",
+})
+
+#: node axis SECOND: [S, N] predicate-signature masks are stored per
+#: shard as [S, N/D] and transposed back on device (P(None, "n"))
+NODE_COL_KEYS = frozenset({"sig_masks"})
+
+
+def split_packed_layout(layout, n_shards: int):
+    """Split a ``SnapshotArrays.packed()`` layout into the replicated part
+    (task/job/queue/misc arrays, placed once per device) and the per-shard
+    node part (node-axis arrays, one slice of N/n_shards rows per mesh
+    device). Offsets are re-accumulated per part, so each part is its own
+    dense flat buffer; per-shard shapes replace the node axis with
+    N/n_shards. Returns ``(rep_layout, node_layout)`` — both in the same
+    sorted-key order as the input, so byte layouts are deterministic.
+
+    Pure layout arithmetic (no arrays touched): the bucket prewarmer uses
+    it to predict the sharded arena's next-bucket jit signatures exactly
+    like predict_next_layout does for the packed path.
+    """
+    rep, node = [], []
+    rf = ri = nf = ni = 0
+    for key, kind, _off, _size, shape in layout:
+        if key in NODE_AXIS_KEYS:
+            n = shape[0]
+            if n % n_shards:
+                raise ValueError(
+                    f"node axis {n} does not divide {n_shards} shards")
+            pshape = (n // n_shards,) + tuple(shape[1:])
+        elif key in NODE_COL_KEYS:
+            n = shape[1]
+            if n % n_shards:
+                raise ValueError(
+                    f"node axis {n} does not divide {n_shards} shards")
+            pshape = (shape[0], n // n_shards)
+        else:
+            size = 1
+            for s in shape:
+                size *= s
+            if kind == "f":
+                rep.append((key, kind, rf, size, shape))
+                rf += size
+            else:
+                rep.append((key, kind, ri, size, shape))
+                ri += size
+            continue
+        size = 1
+        for s in pshape:
+            size *= s
+        if kind == "f":
+            node.append((key, kind, nf, size, pshape))
+            nf += size
+        else:
+            node.append((key, kind, ni, size, pshape))
+            ni += size
+    return tuple(rep), tuple(node)
+
+
+def _part_sizes(part_layout) -> Tuple[int, int]:
+    """(flat f32 length, flat i32 length) of one split-layout part."""
+    nf = max((off + size for _k, kind, off, size, _s in part_layout
+              if kind == "f"), default=0)
+    ni = max((off + size for _k, kind, off, size, _s in part_layout
+              if kind != "f"), default=0)
+    return nf, ni
+
+
+class ShardedDeviceCache(PackedDeviceCache):
+    """The device-resident arena for D>1 sharded solves.
+
+    Same contract as PackedDeviceCache — host mirror diffs, dirty-chunk
+    deltas, pinned score params, soft ``invalidate()`` — but the resident
+    state is laid out for the node-axis ``shard_map`` solver
+    (``parallel.solve_allocate_sharded_arena``):
+
+    - **node-axis arrays** live as one chunked buffer pair PER MESH
+      DEVICE (committed single-device arrays assembled zero-copy into a
+      global ``[D, C, chunk]`` array with ``NamedSharding(mesh, P("n"))``
+      at dispatch time). A dirty node row ships only to the shard that
+      owns it — the per-device scatter executes on that device alone;
+    - **task/job/queue arrays** live as one replicated chunked buffer
+      pair (``NamedSharding(mesh, P())``), delta-updated in place: the
+      host ships each dirty chunk once and the runtime fans it out;
+    - **score params** are pinned with the solver's shardings
+      (node_static split along 'n', scalars replicated), re-validated in
+      place after a collect failure exactly like the packed arena.
+
+    ``update(fbuf, ibuf, layout)`` -> ``(f_rep, i_rep, f_node, i_node,
+    rep_layout, node_layout)``: the six dispatch inputs of
+    ``solve_allocate_sharded_arena``. Accounting adds ``last_shard_bytes``
+    (wire bytes per shard for the last session) on top of the inherited
+    ``volcano_arena_*`` counters; a zero-dirty session returns the
+    resident arrays and ships 0 bytes to every shard.
+    """
+
+    def __init__(self, mesh, chunk: int = 512):
+        super().__init__(chunk)
+        self.mesh = mesh
+        self.D = int(mesh.devices.size)
+        self._rep_layout = None
+        self._node_layout = None
+        # host mirrors: rep flat [Crf*c]/[Cri*c]; node [D, Cnf*c]/[D, Cni*c]
+        self._host_rep_f = self._host_rep_i = None
+        self._host_node_f = self._host_node_i = None
+        # device state: rep = global replicated arrays; node = per-device
+        # committed [1, Cn, chunk] arrays (assembled on demand)
+        self._dev_rep_f = self._dev_rep_i = None
+        self._dev_node_f = self._dev_node_i = None
+        #: wire bytes shipped to each shard by the last session (node
+        #: slices + this shard's copy of the replicated delta)
+        self.last_shard_bytes = [0] * self.D
+
+    # -- placement helpers ---------------------------------------------
+
+    def _sharding(self, along_n: bool):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P("n") if along_n else P()), jax
+
+    def _put_params(self, params: dict) -> dict:
+        ns_n, jax = self._sharding(True)
+        ns_rep, _ = self._sharding(False)
+        return {k: jax.device_put(
+                    np.asarray(v), ns_n if k == "node_static" else ns_rep)
+                for k, v in params.items()}
+
+    # -- lifecycle ------------------------------------------------------
+
+    def reset(self) -> None:
+        super().reset()
+        self._rep_layout = self._node_layout = None
+        self._host_rep_f = self._host_rep_i = None
+        self._host_node_f = self._host_node_i = None
+        self._dev_rep_f = self._dev_rep_i = None
+        self._dev_node_f = self._dev_node_i = None
+
+    def invalidate(self) -> None:
+        """Soft reset after a failed sharded session: the sharded solve
+        never donates, but a mesh-path failure leaves the device-side
+        state untrusted (a shard's scatter may have landed while another
+        shard's was lost) — drop the resident buffers, full-ship next
+        session, and re-validate the pinned params in place."""
+        super().invalidate()
+        self._rep_layout = self._node_layout = None
+        self._dev_rep_f = self._dev_rep_i = None
+        self._dev_node_f = self._dev_node_i = None
+
+    def full_upload_bytes(self) -> int:
+        if self._host_rep_f is None or self._host_node_f is None:
+            return 0
+        return int(self._host_rep_f.nbytes + self._host_rep_i.nbytes
+                   + self._host_node_f.nbytes + self._host_node_i.nbytes)
+
+    # -- host-side packing ---------------------------------------------
+
+    def _pack_split(self, fbuf, ibuf, layout, rep_layout, node_layout,
+                    out_rep_f, out_rep_i, out_node_f, out_node_i) -> None:
+        """Scatter the global packed buffers into the split mirrors:
+        replicated keys copy through; node keys slice one row-block (or
+        sig_masks column-block) per shard."""
+        goff = {k: (off, size, shape) for k, off, size, shape in
+                ((k, off, size, shape)
+                 for k, _kind, off, size, shape in layout)}
+        D = self.D
+        for key, kind, off, size, shape in rep_layout:
+            g_off, g_size, _ = goff[key]
+            src = fbuf if kind == "f" else ibuf
+            dst = out_rep_f if kind == "f" else out_rep_i
+            dst[off:off + size] = src[g_off:g_off + g_size]
+        for key, kind, off, size, pshape in node_layout:
+            g_off, g_size, g_shape = goff[key]
+            src = fbuf if kind == "f" else ibuf
+            dst = out_node_f if kind == "f" else out_node_i
+            g = src[g_off:g_off + g_size].reshape(g_shape)
+            if key in NODE_COL_KEYS:
+                nl = pshape[1]
+                for d in range(D):
+                    dst[d, off:off + size] = \
+                        g[:, d * nl:(d + 1) * nl].ravel()
+            else:
+                nl = pshape[0]
+                for d in range(D):
+                    dst[d, off:off + size] = \
+                        g[d * nl:(d + 1) * nl].ravel()
+
+    # -- the session entry ---------------------------------------------
+
+    def update(self, fbuf: np.ndarray, ibuf: np.ndarray, layout):
+        import jax
+
+        c, D = self.chunk, self.D
+        if self._layout != layout or self._rep_layout is None:
+            rep_layout, node_layout = split_packed_layout(layout, D)
+        else:
+            rep_layout, node_layout = self._rep_layout, self._node_layout
+        rf, ri = _part_sizes(rep_layout)
+        nf, ni = _part_sizes(node_layout)
+        crf = -(-max(rf, 1) // c)
+        cri = -(-max(ri, 1) // c)
+        cnf = -(-max(nf, 1) // c)
+        cni = -(-max(ni, 1) // c)
+
+        if (self._layout != layout or self._host_rep_f is None
+                or self._host_rep_f.size != crf * c
+                or self._host_node_f.shape != (D, cnf * c)):
+            # full ship: (re)build mirrors and place every shard
+            hrf = np.zeros(crf * c, np.float32)
+            hri = np.zeros(cri * c, np.int32)
+            hnf = np.zeros((D, cnf * c), np.float32)
+            hni = np.zeros((D, cni * c), np.int32)
+            self._pack_split(fbuf, ibuf, layout, rep_layout, node_layout,
+                             hrf, hri, hnf, hni)
+            self._host_rep_f, self._host_rep_i = hrf, hri
+            self._host_node_f, self._host_node_i = hnf, hni
+            ns_rep, _ = self._sharding(False)
+            self._dev_rep_f = jax.device_put(hrf.reshape(crf, c), ns_rep)
+            self._dev_rep_i = jax.device_put(hri.reshape(cri, c), ns_rep)
+            devs = list(self.mesh.devices.flat)
+            self._dev_node_f = [
+                jax.device_put(hnf[d].reshape(1, cnf, c), devs[d])
+                for d in range(D)]
+            self._dev_node_i = [
+                jax.device_put(hni[d].reshape(1, cni, c), devs[d])
+                for d in range(D)]
+            self._layout = layout
+            self._rep_layout, self._node_layout = rep_layout, node_layout
+            rep_bytes = hrf.nbytes + hri.nbytes
+            self.last_shard_bytes = [
+                int(hnf[d].nbytes + hni[d].nbytes + rep_bytes)
+                for d in range(D)]
+            self._account(crf + cri + D * (cnf + cni),
+                          rep_bytes + hnf.nbytes + hni.nbytes, full=True)
+            return self._assembled(rep_layout, node_layout)
+
+        # delta path: diff the split mirrors chunk-wise
+        srf = np.zeros(crf * c, np.float32)
+        sri = np.zeros(cri * c, np.int32)
+        snf = np.zeros((D, cnf * c), np.float32)
+        sni = np.zeros((D, cni * c), np.int32)
+        self._pack_split(fbuf, ibuf, layout, rep_layout, node_layout,
+                         srf, sri, snf, sni)
+        drf = np.nonzero((srf.reshape(crf, c)
+                          != self._host_rep_f.reshape(crf, c))
+                         .any(axis=1))[0]
+        dri = np.nonzero((sri.reshape(cri, c)
+                          != self._host_rep_i.reshape(cri, c))
+                         .any(axis=1))[0]
+        chunks = drf.size + dri.size
+        rep_bytes = self._scatter_wire_bytes(drf, dri)
+        if drf.size:
+            self._dev_rep_f = self._apply_keep(
+                self._dev_rep_f, drf, srf.reshape(crf, c))
+        if dri.size:
+            self._dev_rep_i = self._apply_keep(
+                self._dev_rep_i, dri, sri.reshape(cri, c))
+        shard_bytes = [0] * D
+        for d in range(D):
+            dnf = np.nonzero((snf[d].reshape(cnf, c)
+                              != self._host_node_f[d].reshape(cnf, c))
+                             .any(axis=1))[0]
+            dni = np.nonzero((sni[d].reshape(cni, c)
+                              != self._host_node_i[d].reshape(cni, c))
+                             .any(axis=1))[0]
+            if dnf.size:
+                self._dev_node_f[d] = self._apply_keep(
+                    self._dev_node_f[d], dnf, snf[d].reshape(cnf, c),
+                    leading=True)
+            if dni.size:
+                self._dev_node_i[d] = self._apply_keep(
+                    self._dev_node_i[d], dni, sni[d].reshape(cni, c),
+                    leading=True)
+            chunks += dnf.size + dni.size
+            shard_bytes[d] = self._scatter_wire_bytes(dnf, dni)
+        if chunks:
+            self._host_rep_f, self._host_rep_i = srf, sri
+            self._host_node_f, self._host_node_i = snf, sni
+        self.last_shard_bytes = [
+            int(b + (rep_bytes if chunks else 0)) for b in shard_bytes]
+        self._account(chunks, rep_bytes + sum(shard_bytes), full=False)
+        return self._assembled(rep_layout, node_layout)
+
+    @staticmethod
+    def _apply_keep(dev, idx, host2d, leading: bool = False):
+        """Non-donating dirty-chunk scatter (see _scatter_keep); executes
+        on the committed device of ``dev``, so a clean shard receives
+        nothing. ``leading``: dev is a per-device [1, C, chunk] slab."""
+        k = _pow2_bucket(idx.size)
+        pad = np.full(k, idx[0], np.int32)
+        pad[:idx.size] = idx.astype(np.int32)
+        if leading:
+            return _scatter_keep(dev[0], pad, host2d[pad])[None]
+        return _scatter_keep(dev, pad, host2d[pad])
+
+    def _assembled(self, rep_layout, node_layout):
+        """Zero-copy global views over the resident shards: the node
+        slabs become one [D, C, chunk] array sharded along 'n'."""
+        import jax
+
+        c, D = self.chunk, self.D
+        ns_n, _ = self._sharding(True)
+        cnf = self._dev_node_f[0].shape[1]
+        cni = self._dev_node_i[0].shape[1]
+        f_node = jax.make_array_from_single_device_arrays(
+            (D, cnf, c), ns_n, self._dev_node_f)
+        i_node = jax.make_array_from_single_device_arrays(
+            (D, cni, c), ns_n, self._dev_node_i)
+        return (self._dev_rep_f, self._dev_rep_i, f_node, i_node,
+                rep_layout, node_layout)

@@ -215,6 +215,7 @@ def solver_cache_size() -> int:
         # and its compiles are exactly as much a session-thread stall
         from ..parallel import sharded_solver as _ss
         fns.append(_ss.solve_allocate_sharded_packed2d)
+        fns.append(_ss.solve_allocate_sharded_arena)
     except Exception:  # noqa: BLE001 — parallel stack unavailable
         pass
     n = 0
@@ -306,6 +307,35 @@ def dummy_packed_buffers(layout, chunk: int):
     ci = -(-max(ni, 1) // chunk)
     return (np.zeros((cf, chunk), np.float32),
             np.zeros((ci, chunk), np.int32))
+
+
+def dummy_sharded_buffers(layout, chunk: int, mesh):
+    """Zeroed, correctly-sharded dispatch inputs for the sharded arena
+    entry (parallel.solve_allocate_sharded_arena) at a layout: replicated
+    chunked rep buffers + [D, C, chunk] node buffers split along the mesh
+    'n' axis, exactly the shardings ShardedDeviceCache commits — the jit
+    cache keys on (aval, sharding), so a mis-sharded warm would compile a
+    variant the session never dispatches."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .device_cache import _part_sizes, split_packed_layout
+
+    D = int(mesh.devices.size)
+    rep_l, node_l = split_packed_layout(layout, D)
+    rf, ri = _part_sizes(rep_l)
+    nf, ni = _part_sizes(node_l)
+    crf = -(-max(rf, 1) // chunk)
+    cri = -(-max(ri, 1) // chunk)
+    cnf = -(-max(nf, 1) // chunk)
+    cni = -(-max(ni, 1) // chunk)
+    ns_rep = NamedSharding(mesh, P())
+    ns_n = NamedSharding(mesh, P("n"))
+    return (jax.device_put(np.zeros((crf, chunk), np.float32), ns_rep),
+            jax.device_put(np.zeros((cri, chunk), np.int32), ns_rep),
+            jax.device_put(np.zeros((D, cnf, chunk), np.float32), ns_n),
+            jax.device_put(np.zeros((D, cni, chunk), np.int32), ns_n),
+            rep_l, node_l)
 
 
 def dummy_score_params(dims: Dict[str, int]) -> Dict[str, np.ndarray]:
@@ -467,14 +497,32 @@ class BucketPrewarmer:
                     **flags)
                 res.compact.block_until_ready()
             if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
                 from ..parallel.sharded_solver import (
-                    PACKED2D_FLAGS, solve_allocate_sharded_packed2d,
+                    PACKED2D_FLAGS, solve_allocate_sharded_arena,
+                    solve_allocate_sharded_packed2d,
                 )
                 sflags = {k2: v for k2, v in flags.items()
                           if k2 in PACKED2D_FLAGS}
                 rs = solve_allocate_sharded_packed2d(
                     *bufs(), layout, params, self.mesh, **sflags)
                 rs.assigned.block_until_ready()
+                # the sharded ARENA variant too: a sharded session's
+                # bucket crossing dispatches this entry against the
+                # ShardedDeviceCache's shardings (node_static split along
+                # 'n', scalars replicated), so the warm must match them
+                sharded_bufs = dummy_sharded_buffers(
+                    layout, chunk, self.mesh)
+                ns_n = NamedSharding(self.mesh, P("n"))
+                ns_rep = NamedSharding(self.mesh, P())
+                sparams = {k2: jax.device_put(
+                               np.asarray(v),
+                               ns_n if k2 == "node_static" else ns_rep)
+                           for k2, v in dummy_score_params(dims).items()}
+                ra = solve_allocate_sharded_arena(
+                    *sharded_bufs, sparams, self.mesh, **sflags)
+                ra.assigned.block_until_ready()
             with self._lock:
                 self._started[key] = "done"
                 self.completions += 1

@@ -3,6 +3,7 @@ solver-sidecar process boundary."""
 
 from .sharded_evict import solve_evict_uniform_sharded  # noqa: F401
 from .sharded_solver import (  # noqa: F401
-    make_mesh, solve_allocate_sharded, solve_allocate_sharded_packed2d,
+    arena_mesh, make_mesh, solve_allocate_sharded,
+    solve_allocate_sharded_arena, solve_allocate_sharded_packed2d,
 )
 from .sidecar import SidecarSolver, SolverServer  # noqa: F401

@@ -50,6 +50,21 @@ def make_mesh(devices=None, axis: str = "n") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def arena_mesh(devices=None, axis: str = "n", max_devices: int = 0) -> Mesh:
+    """Mesh over the largest power-of-two device prefix: the padded node
+    axis is always a multiple of 8 (ops.arrays.bucket quarter-steps,
+    floor 8), so any power-of-two D <= 8 divides it evenly — a 6-device
+    host would otherwise fail the sharded solver's N % D check."""
+    if devices is None:
+        devices = jax.devices()
+    if max_devices:
+        devices = devices[:max_devices]
+    d = 1
+    while d * 2 <= len(devices) and d < 8:
+        d *= 2
+    return make_mesh(list(devices)[:d], axis)
+
+
 #: static solve flags solve_allocate_sharded_packed2d accepts — a strict
 #: subset of the single-device entries' (no work_conserving/per_node_cap);
 #: the bucket prewarmer filters a session's flag set against this before
@@ -539,6 +554,60 @@ def solve_allocate_sharded_packed2d(f2d, i2d, layout,
     ni = max(off + size for k, kind, off, size, shape in layout
              if kind != "f")
     arrays = _unpack(f2d.reshape(-1)[:nf], i2d.reshape(-1)[:ni], layout)
+    return solve_allocate_sharded(arrays, score_params, mesh, max_rounds,
+                                  max_gang_iters, herd_mode,
+                                  score_families, use_queue_cap,
+                                  use_drf_order, use_hdrf_order, fused)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "rep_layout", "node_layout", "mesh", "max_rounds", "max_gang_iters",
+    "herd_mode", "score_families", "use_queue_cap", "use_drf_order",
+    "use_hdrf_order", "fused"))
+def solve_allocate_sharded_arena(f_rep, i_rep, f_node, i_node,
+                                 rep_layout, node_layout,
+                                 score_params, mesh: Mesh,
+                                 max_rounds: int = 64,
+                                 max_gang_iters: int = 12,
+                                 herd_mode: str = "pack",
+                                 score_families=("binpack",),
+                                 use_queue_cap: bool = False,
+                                 use_drf_order: bool = False,
+                                 use_hdrf_order: bool = False,
+                                 fused: str = "auto") -> SolveResult:
+    """Sharded solve over the SHARDED device-resident arena
+    (ops.device_cache.ShardedDeviceCache): ``f_rep``/``i_rep`` are the
+    replicated chunked task/job buffers, ``f_node``/``i_node`` the
+    ``[D, C, chunk]`` node buffers sharded along the mesh 'n' axis (one
+    resident slab per device). The unpack below is sharding-preserving —
+    slicing the chunked slabs and merging the leading shard axis keeps
+    every node array split exactly as the shard_map in_specs demand, so a
+    steady sharded session dispatches straight off the resident shards
+    with no host re-upload and no cross-device resharding."""
+    from ..ops.device_cache import NODE_COL_KEYS
+    from ..ops.solver import _unpack
+
+    D = mesh.devices.size
+    nf = max((off + size for _k, kind, off, size, _s in rep_layout
+              if kind == "f"), default=0)
+    ni = max((off + size for _k, kind, off, size, _s in rep_layout
+              if kind != "f"), default=0)
+    arrays = _unpack(f_rep.reshape(-1)[:max(nf, 1)],
+                     i_rep.reshape(-1)[:max(ni, 1)],
+                     tuple(e for e in rep_layout))
+    fn = f_node.reshape(D, -1)
+    im = i_node.reshape(D, -1)
+    for key, kind, off, size, pshape in node_layout:
+        src = fn if kind == "f" else im
+        v = src[:, off:off + size].reshape((D,) + tuple(pshape))
+        if kind == "b":
+            v = v.astype(bool)
+        if key in NODE_COL_KEYS:
+            # [D, S, N/D] -> [S, N]: the merged axis stays sharded on 'n'
+            v = v.transpose(1, 0, 2).reshape(pshape[0], D * pshape[1])
+        else:
+            v = v.reshape((D * pshape[0],) + tuple(pshape[1:]))
+        arrays[key] = v
     return solve_allocate_sharded(arrays, score_params, mesh, max_rounds,
                                   max_gang_iters, herd_mode,
                                   score_families, use_queue_cap,

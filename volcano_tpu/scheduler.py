@@ -36,7 +36,9 @@ class Scheduler:
                  pipeline_solver: bool = True,
                  action_deadline_s: Optional[float] = None,
                  breaker_failures: int = 3,
-                 breaker_cooldown_s: float = 30.0):
+                 breaker_cooldown_s: float = 30.0,
+                 solver_mode: Optional[str] = None,
+                 sharded_byte_budget: int = 0):
         # adaptive host-loop node sampling knob, instance-scoped
         # (cmd/scheduler/app/options/options.go:37-40)
         from .utils import NodeSampler
@@ -64,6 +66,15 @@ class Scheduler:
         self.action_deadline_s = action_deadline_s
         self._watchdog = ActionWatchdog(action_deadline_s) \
             if action_deadline_s else None
+        # --solver-mode preference (None keeps per-action conf routing):
+        # "packed" pins the single-device solver, "sharded" the node-axis
+        # shard_map solver over the sharded arena, "auto" shards exactly
+        # when the padded problem's device-resident footprint exceeds the
+        # per-device byte budget (framework.interface.Action.resolve_mode)
+        if solver_mode:
+            cache.solver_mode = solver_mode
+        if sharded_byte_budget:
+            cache.sharded_byte_budget = int(sharded_byte_budget)
         # compile-and-dispatch pipeline (ops.precompile): persistent
         # on-disk XLA executable cache (explicit dir or
         # $VOLCANO_COMPILE_CACHE_DIR), background next-bucket pre-warm,
@@ -244,24 +255,43 @@ class Scheduler:
         timing["session_compiles"] = float(c - prev_c)
         timing["session_compile_s"] = s - prev_s
         timing["compile_cache_hits"] = float(watcher.cache_hits)
-        dc = getattr(self.cache, "device_cache", None)
-        if dc is not None and getattr(dc, "sessions", 0):
-            # device-resident arena accounting (ops.device_cache): wire
-            # bytes per steady session and the hit rate are the two
-            # numbers that say whether the RTT-floor amortization is
-            # actually engaged (per-cycle bytes come from the allocate
-            # action's timing; these are the arena's cumulative view)
-            timing["arena_hit_rate"] = dc.arena_hit_rate
-            metrics.arena_bytes_shipped.set(
-                timing.get("arena_bytes_shipped", dc.last_shipped_bytes))
-            metrics.arena_bytes_shipped_total.set(dc.total_shipped_bytes)
-            metrics.arena_hit_rate.set(dc.arena_hit_rate)
+        # device-resident arena accounting (ops.device_cache), exported
+        # PER SOLVER MODE: a sharded session's wire bytes land on the
+        # sharded arena's series, never on the packed one — wire bytes
+        # per steady session and the hit rate are the two numbers that
+        # say whether the RTT-floor amortization is actually engaged
+        # (per-cycle bytes come from the allocate action's timing; the
+        # gauges are each arena's cumulative view)
+        active_mode = timing.get("arena_mode")
+        for mode, attr in (("packed", "device_cache"),
+                           ("sharded", "sharded_device_cache")):
+            dc = getattr(self.cache, attr, None)
+            if dc is None or not getattr(dc, "sessions", 0):
+                continue
+            lbl = {"mode": mode}
+            if mode == active_mode or active_mode is None:
+                timing["arena_hit_rate"] = dc.arena_hit_rate
+            per_cycle = (timing.get("arena_bytes_shipped",
+                                    dc.last_shipped_bytes)
+                         if mode == active_mode else dc.last_shipped_bytes)
+            metrics.arena_bytes_shipped.set(per_cycle, labels=lbl)
+            metrics.arena_bytes_shipped_total.set(
+                dc.total_shipped_bytes, labels=lbl)
+            metrics.arena_hit_rate.set(dc.arena_hit_rate, labels=lbl)
             metrics.arena_sessions_total.set(
-                dc.delta_sessions, labels={"outcome": "delta"})
+                dc.delta_sessions, labels={"outcome": "delta",
+                                           "mode": mode})
             metrics.arena_sessions_total.set(
-                dc.full_ships, labels={"outcome": "full"})
-            metrics.arena_invalidations_total.set(dc.invalidations)
-            metrics.arena_params_repins_total.set(dc.params_repins)
+                dc.full_ships, labels={"outcome": "full", "mode": mode})
+            metrics.arena_invalidations_total.set(
+                dc.invalidations, labels=lbl)
+            metrics.arena_params_repins_total.set(
+                dc.params_repins, labels=lbl)
+            if mode == "sharded":
+                for d, b in enumerate(
+                        getattr(dc, "last_shard_bytes", ())):
+                    metrics.arena_shard_bytes_shipped.set(
+                        b, labels={"shard": str(d)})
         pw = getattr(self.cache, "prewarmer", None)
         if pw is not None:
             timing["prewarm_completions"] = float(pw.completions)

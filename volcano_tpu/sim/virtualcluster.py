@@ -110,7 +110,9 @@ class VirtualCluster:
     def __init__(self, workload: Workload, mode: str = "solver",
                  scheduler_conf: Optional[str] = None, dt: float = 1.0,
                  grace_cycles: int = 2, preempt: bool = False,
-                 recorder: Optional[DecisionRecorder] = None):
+                 recorder: Optional[DecisionRecorder] = None,
+                 solver_mode: Optional[str] = None,
+                 sharded_byte_budget: int = 0):
         self.workload = workload
         self.dt = float(dt)
         self.clock = VirtualClock()
@@ -122,6 +124,12 @@ class VirtualCluster:
         # look ancient to time.time()); the virtual kubelet below owns
         # eviction finalization instead
         self.cache.EVICTION_FINALIZE_GRACE = float("inf")
+        # --solver-mode routing (vcctl sim): the deployment-level
+        # preference applies only when the conf leaves the allocate mode
+        # implicit (Action.resolve_mode), same as standalone
+        if solver_mode:
+            self.cache.solver_mode = solver_mode
+            self.cache.sharded_byte_budget = int(sharded_byte_budget)
         self.cache.decision_recorder = self.recorder
         self.cache.binder = RecordingBinder(
             DefaultBinder(self.store), on_bind=self._on_bind)

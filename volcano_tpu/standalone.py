@@ -52,7 +52,9 @@ class Standalone:
                  breaker_failures: int = 3,
                  breaker_cooldown_s: float = 30.0,
                  sim_record: Optional[str] = None,
-                 sim_trace: Optional[str] = None):
+                 sim_trace: Optional[str] = None,
+                 solver_mode: Optional[str] = None,
+                 sharded_byte_budget: int = 0):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -189,7 +191,9 @@ class Standalone:
             pipeline_solver=pipeline_solver,
             action_deadline_s=action_deadline_s,
             breaker_failures=breaker_failures,
-            breaker_cooldown_s=breaker_cooldown_s)
+            breaker_cooldown_s=breaker_cooldown_s,
+            solver_mode=solver_mode,
+            sharded_byte_budget=sharded_byte_budget)
         # pipeline_effects: don't drain the async bind effectors between
         # control-plane turns — cycle N's API writes overlap cycle N+1's
         # snapshot+flatten (see Scheduler.run). Off by default: embedding
@@ -347,6 +351,22 @@ def main(argv=None) -> int:
                     help="drive this control plane from a sim workload "
                          "trace (volcano_tpu.sim JSONL): arrivals submit "
                          "as Jobs when their cycle comes due")
+    ap.add_argument("--solver-mode", default=None,
+                    choices=["packed", "sharded", "auto"],
+                    help="device-solver routing when the scheduler conf "
+                         "leaves the allocate mode implicit: packed = "
+                         "single-device device-resident arena, sharded = "
+                         "node-axis shard_map solver over the sharded "
+                         "arena, auto = shard exactly when the padded "
+                         "problem's device-resident footprint (one full "
+                         "upload at the measured layout) exceeds "
+                         "--sharded-byte-budget bytes per device")
+    ap.add_argument("--sharded-byte-budget", type=int,
+                    default=256 * 1024 * 1024, metavar="BYTES",
+                    help="per-device resident-state budget for "
+                         "--solver-mode auto (default 256 MiB; the first "
+                         "session always runs packed — no layout has "
+                         "been measured yet)")
     args = ap.parse_args(argv)
 
     conf = None
@@ -372,7 +392,9 @@ def main(argv=None) -> int:
                     breaker_failures=args.breaker_failures,
                     breaker_cooldown_s=args.breaker_cooldown,
                     sim_record=args.sim_record,
-                    sim_trace=args.sim_trace)
+                    sim_trace=args.sim_trace,
+                    solver_mode=args.solver_mode,
+                    sharded_byte_budget=args.sharded_byte_budget)
     if args.jobs_dir:
         import glob
         import os
