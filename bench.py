@@ -1830,6 +1830,69 @@ def sim_quality():
     return out
 
 
+def reschedule_defrag():
+    """Defragmentation A/B on the seeded fragmented 500-cycle trace
+    (ISSUE 8 acceptance config): the SAME workload run golden
+    (no reschedule) and with the global rescheduler enabled, both on the
+    binpack conf. Reports utilization / fragmentation_index / wait p99
+    per arm plus per-plan budget and cap compliance; ``ok`` asserts the
+    acceptance trio (utilization up, fragmentation down, p99 no worse)
+    with moves <= budget and per-job caps never exceeded. Per-arm fault
+    isolation: one arm crashing records an error field, the other's
+    score survives."""
+    from volcano_tpu.sim.replay import run_sim
+    from volcano_tpu.sim.virtualcluster import BINPACK_CONF
+    from volcano_tpu.sim.workload import fragmented_workload
+
+    cycles, nodes, seed = 500, 9, 7
+    knobs = {"interval": 5, "max_moves": 8, "max_disruption_per_job": 2}
+    out = {"cycles": cycles, "nodes": nodes, "seed": seed, **knobs}
+    arms = {}
+    for arm, resched in (("golden", None), ("reschedule", knobs)):
+        t0 = time.perf_counter()
+        try:
+            r = run_sim(
+                workload=fragmented_workload(seed=seed, cycles=cycles,
+                                             nodes=nodes),
+                cycles=cycles, scheduler_conf=BINPACK_CONF,
+                reschedule=resched)
+            arms[arm] = r
+            out[arm] = {"score": r.score,
+                        "wall_s": round(time.perf_counter() - t0, 1)}
+        except Exception as e:  # noqa: BLE001 — per-arm isolation
+            out[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if "golden" in arms and "reschedule" in arms:
+        g = arms["golden"].score
+        r = arms["reschedule"].score
+        plans = arms["reschedule"].vc.cache.reschedule_log
+        executed = [p for p in plans if p["rejected"] is None]
+        out["plans"] = {
+            "built": len(plans),
+            "executed": len(executed),
+            "moves_executed": int(sum(p["executed"] for p in executed)),
+            "max_moves_in_plan": max((p["selected"] for p in executed),
+                                     default=0),
+            "max_disruption": max((p["max_disruption"] for p in executed),
+                                  default=0),
+            "budget": knobs["max_moves"],
+            "per_job_cap": knobs["max_disruption_per_job"],
+        }
+        out["improved"] = {
+            "utilization": r["utilization_mean"] > g["utilization_mean"],
+            "fragmentation":
+                r["fragmentation_index"] < g["fragmentation_index"],
+            "wait_p99_no_worse": r["wait_p99"] <= g["wait_p99"],
+            "budget_respected": all(
+                p["selected"] <= knobs["max_moves"] for p in plans),
+            "caps_respected": all(
+                p["max_disruption"] <= knobs["max_disruption_per_job"]
+                for p in plans),
+            "migrated": r["migrations"] > 0,
+        }
+        out["ok"] = all(out["improved"].values())
+    return out
+
+
 def _transient_markers():
     """Shared with the in-scheduler dispatch retry
     (volcano_tpu.resilience.transient) so both layers agree on what
@@ -1892,6 +1955,7 @@ def _main_inner() -> dict:
         ("chaos_churn_50", chaos_churn),
         ("failover_ha", failover),
         ("sim_quality_500c", sim_quality),
+        ("reschedule_defrag", reschedule_defrag),
     ):
         configs[name] = _run_config(name, fn)
     setup_s = time.time() - t_setup

@@ -1,0 +1,518 @@
+"""Global rescheduler: plan bounding units, device-solved hole punching
+at the action level, migration-intent crash safety, and the fragmented
+sim A/B.
+
+Tier-1 (fast) coverage: every bounding rule of build_plan in isolation
+(budget, PDB-style per-job caps, landing feasibility, fits / no-op
+rejections, selection order), the reschedule action end-to-end on a
+small fragmented in-memory cluster (device solve included), the
+migration-intent journal lifecycle, and a kill-the-leader
+mid-migration-plan proof (intent durable, zero evictions applied,
+successor abandons and re-solves — zero lost / duplicate binds). The
+500-cycle fragmented A/B soak is marked slow; `bench.py
+reschedule_defrag` records the same numbers."""
+
+import pytest
+
+from helpers import build_node, build_pod, build_pod_group, build_queue
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.client import ClusterStore
+from volcano_tpu.models import PodGroupPhase
+from volcano_tpu.reschedule import (
+    MIGRATION_REASON, MigrationIntentJournal, MoveCandidate, build_plan,
+    reconcile_migration_intents, stranded_fraction,
+)
+from volcano_tpu.resilience import BindIntentJournal, faults
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.utils.leader_election import LeaderElector, LeaseLock
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def mk(key, job, frm, to, cpu, mem=1.0):
+    ns, name = key.split("/")
+    return MoveCandidate(key=key, namespace=ns, name=name, job_uid=job,
+                        from_node=frm, to_node=to, cpu=cpu, mem=mem)
+
+
+GiB = 1 << 30
+
+
+# ---------------------------------------------------------------------------
+# plan bounding (pure host)
+# ---------------------------------------------------------------------------
+
+class TestPlanBounding:
+    FREE = {"n0": (4000.0, 64 * GiB), "n1": (4000.0, 64 * GiB),
+            "n2": (4000.0, 64 * GiB)}
+
+    def _cands(self):
+        return [mk("t/a-0", "ja", "n0", "n1", 2000.0),
+                mk("t/b-0", "jb", "n0", "n2", 2000.0)]
+
+    def test_hole_punched_within_budget_and_caps(self):
+        plan = build_plan(self._cands(), self.FREE, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected is None
+        assert plan.hole_node == "n0"
+        assert [m.key for m in plan.moves] == ["t/a-0", "t/b-0"]
+        assert plan.max_disruption == 1
+        assert plan.largest_after >= 8000.0
+        assert plan.frag_before == 1.0 and plan.frag_after < 1.0
+        assert plan.capped == 0
+
+    def test_budget_exhausted_rejects_whole_plan(self):
+        # two moves are needed to reach the shape; budget 1 cannot, and
+        # a half-punched hole is pure churn — rejected whole
+        plan = build_plan(self._cands(), self.FREE, max_moves=1,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "no_hole"
+        assert plan.moves == []
+        assert plan.capped == 2
+
+    def test_per_job_cap_blocks_gang_shredding(self):
+        cands = [mk("t/a-0", "ja", "n0", "n1", 2000.0),
+                 mk("t/a-1", "ja", "n0", "n2", 2000.0)]
+        plan = build_plan(cands, self.FREE, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "no_hole"
+        plan = build_plan(cands, self.FREE, max_moves=8,
+                          max_disruption_per_job=2, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected is None
+        assert plan.max_disruption == 2
+
+    def test_noop_churn_rejected_by_min_improvement(self):
+        plan = build_plan(self._cands(), self.FREE, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=1.5,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "no_gain"
+        assert plan.moves == []
+
+    def test_healthy_cluster_rejected_as_fits(self):
+        free = dict(self.FREE, n2=(9000.0, 64 * GiB))
+        plan = build_plan(self._cands(), free, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "fits"
+
+    def test_empty_and_zero_budget(self):
+        plan = build_plan([], self.FREE, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "empty"
+        plan = build_plan(self._cands(), self.FREE, max_moves=0,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "budget"
+
+    def test_landing_feasibility_prevents_boomerang(self):
+        # nowhere outside the hole fits the displaced movers: selecting
+        # them would only see allocate re-place them into the hole
+        free = {"n0": (4000.0, 64 * GiB), "n1": (1000.0, 64 * GiB),
+                "n2": (1000.0, 64 * GiB)}
+        plan = build_plan(self._cands(), free, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected == "no_hole"
+
+    def test_smallest_movers_preferred(self):
+        # a 2000+2000 pair reaches the shape; the 4000 long-runner is
+        # spared even though biggest-first would have taken it alone
+        cands = [mk("t/long-0", "jl", "n0", "n1", 4000.0),
+                 mk("t/a-0", "ja", "n0", "n1", 2000.0),
+                 mk("t/b-0", "jb", "n0", "n2", 2000.0)]
+        plan = build_plan(cands, self.FREE, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected is None
+        assert sorted(m.key for m in plan.moves) == ["t/a-0", "t/b-0"]
+
+    def test_biggest_fallback_when_budget_starves_small_movers(self):
+        # budget 1 exhausts smallest-first before the shape is reached;
+        # the biggest-first fallback still achieves the hole in one move
+        cands = [mk("t/long-0", "jl", "n0", "n1", 4000.0),
+                 mk("t/a-0", "ja", "n0", "n1", 2000.0),
+                 mk("t/b-0", "jb", "n0", "n2", 2000.0)]
+        plan = build_plan(cands, self.FREE, max_moves=1,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected is None
+        assert [m.key for m in plan.moves] == ["t/long-0"]
+
+    def test_unpinned_site_choice_is_cheapest(self):
+        # n1 needs one move, n0 needs two: the unpinned planner picks n1
+        free = {"n0": (4000.0, 64 * GiB), "n1": (6000.0, 64 * GiB),
+                "n2": (6000.0, 64 * GiB)}
+        cands = [mk("t/a-0", "ja", "n0", "n2", 2000.0),
+                 mk("t/b-0", "jb", "n0", "n2", 2000.0),
+                 mk("t/c-0", "jc", "n1", "n2", 2000.0)]
+        plan = build_plan(cands, free, max_moves=8,
+                          max_disruption_per_job=1, min_improvement=0.01,
+                          ref_cpu=8000.0)
+        assert plan.rejected is None
+        assert plan.hole_node == "n1"
+        assert [m.key for m in plan.moves] == ["t/c-0"]
+
+    def test_stranded_fraction(self):
+        assert stranded_fraction([4000, 4000], 8000) == 1.0
+        assert stranded_fraction([8000, 0], 8000) == 0.0
+        assert stranded_fraction([], 8000) == 0.0
+        assert stranded_fraction([4000, 4000], 0) == 0.0
+        assert stranded_fraction([6000, 2000], 4000) == 0.25
+
+
+# ---------------------------------------------------------------------------
+# the action: device-solved hole punch on a small fragmented cluster
+# ---------------------------------------------------------------------------
+
+RESCHED_CONF = """
+actions: "reschedule"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: binpack
+  - name: nodeorder
+configurations:
+- name: reschedule
+  arguments:
+    reschedule.interval: 1
+    reschedule.maxMoves: {moves}
+    reschedule.maxDisruptionPerJob: {cap}
+    reschedule.minImprovement: {imp}
+"""
+
+
+def _fragmented_store(same_job_pairs: bool = False) -> ClusterStore:
+    """3 x 8cpu nodes, each holding 2 x 2cpu running tasks (free 4cpu
+    everywhere), plus one pending 8cpu job: total free 12cpu, largest
+    slot 4cpu — the reference shape fits nowhere. With
+    ``same_job_pairs`` each node's two tasks belong to ONE gang job, so
+    a per-job disruption cap of 1 makes every hole unreachable."""
+    store = ClusterStore()
+    store.apply("queues", build_queue("q0", weight=1))
+    for i in range(3):
+        store.create("nodes", build_node(f"n{i}",
+                                         {"cpu": "8", "memory": "32Gi"}))
+    pairs = [("a", "b"), ("c", "d"), ("e", "f")]
+    if same_job_pairs:
+        pairs = [("a", "a"), ("c", "c"), ("e", "e")]
+    for i, (j1, j2) in enumerate(pairs):
+        for k, jn in enumerate((j1, j2)):
+            pg_name = f"j{jn}"
+            if store.try_get("podgroups", pg_name, "t") is None:
+                members = 2 if j1 == j2 else 1
+                pg = build_pod_group(pg_name, "t", min_member=members,
+                                     queue="q0")
+                pg.status.phase = PodGroupPhase.RUNNING
+                store.create("podgroups", pg)
+            store.create("pods", build_pod(
+                "t", f"{jn}-{k}" if j1 == j2 else f"{jn}-0", f"n{i}",
+                "Running", {"cpu": "2", "memory": "4Gi"}, pg_name))
+    pg = build_pod_group("jg", "t", min_member=1, queue="q0")
+    pg.status.phase = PodGroupPhase.INQUEUE
+    store.create("podgroups", pg)
+    store.create("pods", build_pod(
+        "t", "g-0", "", "Pending", {"cpu": "8", "memory": "8Gi"}, "jg"))
+    return store
+
+
+def _evicted(store):
+    return sorted(p.name for p in store.list("pods", namespace="t")
+                  if p.deletion_timestamp is not None)
+
+
+def _run_resched(store, moves=8, cap=1, imp=0.01):
+    cache = SchedulerCache(store)
+    cache.run()
+    conf = RESCHED_CONF.format(moves=moves, cap=cap, imp=imp)
+    sched = Scheduler(cache, scheduler_conf=conf)
+    sched.run_once()
+    return cache, sched
+
+
+class TestRescheduleAction:
+    def test_hole_punched_on_device_and_evictions_fenced_off(self):
+        store = _fragmented_store()
+        cache, sched = _run_resched(store)
+        # the two movers on the hole node are evicted with the migration
+        # reason; everything else is untouched
+        assert _evicted(store) == ["a-0", "b-0"]
+        for p in store.list("pods", namespace="t"):
+            if p.name in ("a-0", "b-0"):
+                cond = [c for c in p.conditions
+                        if c.get("reason") == "Evict"][-1]
+                assert cond["message"].startswith(MIGRATION_REASON)
+            else:
+                assert p.deletion_timestamp is None
+        rec = cache.reschedule_log[-1]
+        assert rec["rejected"] is None
+        assert rec["hole_node"] == "n0"
+        assert rec["executed"] == 2 <= rec["budget"]
+        assert rec["max_disruption"] <= 1
+        assert rec["frag_before"] == 1.0 and rec["frag_after"] < 1.0
+        t = sched.last_cycle_timing
+        assert t["reschedule_moves_executed"] == 2.0
+        assert t["reschedule_frag_post"] < t["reschedule_frag_pre"]
+        assert t["reschedule_solve_ms"] > 0.0
+
+    def test_budget_too_small_rejects_whole_plan(self):
+        store = _fragmented_store()
+        cache, _ = _run_resched(store, moves=1)
+        assert _evicted(store) == []
+        assert cache.reschedule_log[-1]["rejected"] == "no_hole"
+
+    def test_per_job_cap_skips_pass_without_device_work(self):
+        # both movers on n0 belong to ONE job; cap 1 makes every node
+        # unreachable and the pre-solve check skips before any dispatch
+        store = _fragmented_store(same_job_pairs=True)
+        cache, sched = _run_resched(store, cap=1)
+        assert _evicted(store) == []
+        assert cache.reschedule_log == []
+        assert sched.last_cycle_timing["reschedule_skipped"] == "no_hole"
+
+    def test_min_improvement_rejects_noop_churn(self):
+        store = _fragmented_store()
+        cache, _ = _run_resched(store, imp=1.5)
+        assert _evicted(store) == []
+        assert cache.reschedule_log[-1]["rejected"] == "no_gain"
+
+    def test_healthy_cluster_skips_before_the_solve(self):
+        store = _fragmented_store()
+        store.create("nodes", build_node("n3", {"cpu": "8",
+                                                "memory": "32Gi"}))
+        cache, sched = _run_resched(store)
+        assert _evicted(store) == []
+        assert sched.last_cycle_timing["reschedule_skipped"] == "fits"
+        assert cache.reschedule_log == []
+
+    def test_interval_gates_passes(self):
+        store = _fragmented_store()
+        cache = SchedulerCache(store)
+        cache.run()
+        conf = RESCHED_CONF.format(moves=8, cap=1, imp=0.01).replace(
+            "reschedule.interval: 1", "reschedule.interval: 3")
+        sched = Scheduler(cache, scheduler_conf=conf)
+        sched.run_once()   # cycle 1: pass runs
+        first = _evicted(store)
+        assert first == ["a-0", "b-0"]
+        sched.run_once()   # cycle 2: interval skip
+        assert sched.last_cycle_timing["reschedule_skipped"] == "interval"
+        assert _evicted(store) == first
+
+
+# ---------------------------------------------------------------------------
+# migration-intent journal + takeover reconciliation
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestMigrationIntentJournal:
+    def _moves(self):
+        return [mk("t/a-0", "ja", "n0", "n1", 2000.0),
+                mk("t/b-0", "jb", "n0", "n2", 2000.0)]
+
+    def test_record_then_sweep_confirms_once_pods_gone(self):
+        store = _fragmented_store()
+        journal = MigrationIntentJournal(store, identity="A")
+        intent = journal.record(self._moves())
+        assert store.get("migrationintents", intent.name).moves == [
+            ["t", "a-0", "n0", "n1"], ["t", "b-0", "n0", "n2"]]
+        # pods still running on their source: first sweep keeps it
+        assert journal.sweep() == 0
+        # evictions land (deletion stamped) -> the next sweep confirms
+        for name in ("a-0", "b-0"):
+            pod = store.get("pods", name, "t")
+            pod.deletion_timestamp = 1.0
+            store.update("pods", pod)
+        assert journal.sweep() == 1
+        assert store.try_get("migrationintents", intent.name) is None
+
+    def test_stale_intent_swept_after_two_generations(self):
+        store = _fragmented_store()
+        journal = MigrationIntentJournal(store, identity="A")
+        intent = journal.record(self._moves())
+        assert journal.sweep() == 0   # gen 1: kept (young, unsettled)
+        assert journal.sweep() == 1   # gen 2: presumed contained
+        assert store.try_get("migrationintents", intent.name) is None
+
+    def test_reconcile_settles_and_abandons_against_pod_truth(self):
+        store = _fragmented_store()
+        journal = MigrationIntentJournal(store, identity="A")
+        journal.record(self._moves())
+        # a-0's eviction landed before the crash; b-0's never dispatched
+        pod = store.get("pods", "a-0", "t")
+        pod.deletion_timestamp = 1.0
+        store.update("pods", pod)
+        summary = reconcile_migration_intents(store)
+        assert summary == {"intents": 1, "settled": 1, "abandoned": 1}
+        assert store.list("migrationintents") == []
+        # the abandoned eviction is NOT re-driven: b-0 still runs
+        assert store.get("pods", "b-0", "t").deletion_timestamp is None
+
+
+class TestKillTheLeaderMidMigration:
+    def test_crash_between_journal_and_evictions_abandons_cleanly(self):
+        """Leader crashes after the wave's migration intent is durable
+        but before any eviction dispatches: the successor abandons the
+        wave (never re-drives a stale eviction), pod truth is untouched
+        — zero lost, zero duplicate binds — and the successor's own pass
+        re-solves against fresh state."""
+        clock = FakeClock()
+        store = _fragmented_store()
+        store.clock = clock
+        binds_before = {p.name: p.node_name
+                        for p in store.list("pods", namespace="t")}
+
+        cache_a = SchedulerCache(store)
+        cache_a.run()
+        ea = LeaderElector(LeaseLock(store, "volcano"), identity="A",
+                           lease_duration=10.0, clock=clock)
+        assert ea.step()
+        cache_a.install_fencing(ea.fencing_token)
+        cache_a.bind_journal = BindIntentJournal(
+            cache_a.fenced_cluster, identity="A", clock=clock)
+        faults.arm("migration_commit", at=(1,))
+        sched_a = Scheduler(cache_a,
+                            scheduler_conf=RESCHED_CONF.format(
+                                moves=8, cap=1, imp=0.01))
+        sched_a.run_once()  # FaultError contained by the action wrapper
+        faults.reset()
+        # the wave is durable, nothing was applied
+        assert len(store.list("migrationintents")) == 1
+        assert _evicted(store) == []
+
+        # A crashes; B takes over past lease expiry and reconciles
+        clock.t += 11
+        eb = LeaderElector(LeaseLock(store, "volcano"), identity="B",
+                           lease_duration=10.0, clock=clock)
+        assert eb.step()
+        summary = reconcile_migration_intents(store, eb.fencing_token)
+        assert summary["intents"] == 1
+        assert summary["abandoned"] == 2 and summary["settled"] == 0
+        assert store.list("migrationintents") == []
+        # pod truth: every bind exactly as before the crash, no evictions
+        assert {p.name: p.node_name
+                for p in store.list("pods", namespace="t")} == binds_before
+        assert _evicted(store) == []
+
+        # the successor's own pass re-solves fresh and migrates normally
+        cache_b = SchedulerCache(store)
+        cache_b.run()
+        cache_b.install_fencing(eb.fencing_token)
+        cache_b.bind_journal = BindIntentJournal(
+            cache_b.fenced_cluster, identity="B", clock=clock)
+        sched_b = Scheduler(cache_b,
+                            scheduler_conf=RESCHED_CONF.format(
+                                moves=8, cap=1, imp=0.01))
+        sched_b.run_once()
+        assert _evicted(store) == ["a-0", "b-0"]
+        # B journaled its own wave; a sweep after settlement clears it
+        assert len(store.list("migrationintents")) == 1
+
+    def test_deposed_leader_cannot_journal_new_waves(self):
+        clock = FakeClock()
+        store = _fragmented_store()
+        store.clock = clock
+        ea = LeaderElector(LeaseLock(store, "volcano"), identity="A",
+                           lease_duration=10.0, clock=clock)
+        assert ea.step()
+        from volcano_tpu.client import FencedStore
+        fenced = FencedStore(store, ea.fencing_token)
+        journal = MigrationIntentJournal(fenced, identity="A",
+                                         clock=clock)
+        clock.t += 11
+        eb = LeaderElector(LeaseLock(store, "volcano"), identity="B",
+                           lease_duration=10.0, clock=clock)
+        assert eb.step()
+        from volcano_tpu.client import FencedError
+        with pytest.raises(FencedError):
+            journal.record([mk("t/a-0", "ja", "n0", "n1", 2000.0)])
+        assert store.list("migrationintents") == []
+
+
+# ---------------------------------------------------------------------------
+# the fragmented sim A/B (the tentpole's judgement)
+# ---------------------------------------------------------------------------
+
+class TestFragmentedSimAB:
+    def test_fast_ab_executes_bounded_migrations(self):
+        """Tier-1 smoke at reduced scale: the reschedule arm actually
+        migrates, never exceeds its budget or per-job caps, and every
+        executed plan projects a fragmentation improvement."""
+        from volcano_tpu.sim.replay import run_sim
+        from volcano_tpu.sim.virtualcluster import BINPACK_CONF
+        from volcano_tpu.sim.workload import fragmented_workload
+
+        wl = fragmented_workload(seed=7, cycles=40, nodes=6)
+        r = run_sim(workload=wl, cycles=40, scheduler_conf=BINPACK_CONF,
+                    reschedule={"interval": 5, "max_moves": 8,
+                                "max_disruption_per_job": 2})
+        assert r.score["migrations"] > 0
+        assert r.score["migration_churn"] > 0.0
+        executed = [rec for rec in r.vc.cache.reschedule_log
+                    if rec["rejected"] is None]
+        assert executed
+        for rec in r.vc.cache.reschedule_log:
+            assert rec["selected"] <= rec["budget"]
+            assert rec["max_disruption"] <= rec["per_job_cap"]
+            if rec["rejected"] is None:
+                assert rec["frag_after"] < rec["frag_before"]
+
+    def test_fragmented_preset_is_seed_deterministic(self):
+        from volcano_tpu.sim.workload import fragmented_workload
+        a = fragmented_workload(seed=11, cycles=30, nodes=6)
+        b = fragmented_workload(seed=11, cycles=30, nodes=6)
+        c = fragmented_workload(seed=12, cycles=30, nodes=6)
+        assert a.events == b.events
+        assert a.events != c.events
+
+    @pytest.mark.slow
+    def test_full_500_cycle_ab_improves_quality(self):
+        """The acceptance soak: on the seeded fragmented 500-cycle
+        trace, the reschedule arm improves utilization and the
+        fragmentation index versus the no-reschedule golden run with
+        wait p99 no worse, executed moves <= budget and per-job caps
+        never exceeded."""
+        from volcano_tpu.sim.replay import run_sim
+        from volcano_tpu.sim.virtualcluster import BINPACK_CONF
+        from volcano_tpu.sim.workload import fragmented_workload
+
+        cycles, nodes = 500, 9
+        golden = run_sim(
+            workload=fragmented_workload(seed=7, cycles=cycles,
+                                         nodes=nodes),
+            cycles=cycles, scheduler_conf=BINPACK_CONF)
+        resched = run_sim(
+            workload=fragmented_workload(seed=7, cycles=cycles,
+                                         nodes=nodes),
+            cycles=cycles, scheduler_conf=BINPACK_CONF,
+            reschedule={"interval": 5, "max_moves": 8,
+                        "max_disruption_per_job": 2})
+        g, r = golden.score, resched.score
+        assert r["migrations"] > 0
+        assert r["utilization_mean"] > g["utilization_mean"]
+        assert r["fragmentation_index"] < g["fragmentation_index"]
+        assert r["wait_p99"] <= g["wait_p99"]
+        for rec in resched.vc.cache.reschedule_log:
+            assert rec["selected"] <= rec["budget"]
+            assert rec["max_disruption"] <= rec["per_job_cap"]

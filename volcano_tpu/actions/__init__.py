@@ -17,6 +17,10 @@ def register_all() -> None:
             register_action(getattr(mod, f"{name.capitalize()}Action")())
         except (ImportError, AttributeError):
             pass
+    # the global rescheduler lives in its own subsystem package
+    # (volcano_tpu.reschedule) but registers like any other action
+    from ..reschedule import RescheduleAction
+    register_action(RescheduleAction())
 
 
 register_all()

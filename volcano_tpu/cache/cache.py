@@ -322,6 +322,15 @@ class SchedulerCache:
         # is on
         self.bind_journal = None
         self.fenced_cluster = None
+        # global rescheduler (volcano_tpu.reschedule): deployment-level
+        # defaults for the reschedule action (--reschedule-* flags; per-
+        # action conf arguments override), its cross-session state (cycle
+        # counter, dedicated flatten/device caches, migration-intent
+        # journal) and the bounded per-plan history the defrag bench and
+        # tests read budget/cap compliance from
+        self.reschedule_opts = None
+        self.reschedule_state = None
+        self.reschedule_log = []
 
         # job uid -> flat_version reflected by the last successful status
         # write; the job updater's skip-if-untouched check compares against

@@ -308,19 +308,38 @@ def sim_cmd(args, cluster: ClusterStore) -> str:
     import json
 
     from ..sim import replay as sim_replay
-    from ..sim.workload import Workload, WorkloadSpec
+    from ..sim.workload import WORKLOAD_PRESETS, Workload, WorkloadSpec
 
     spec = WorkloadSpec(seed=args.seed, cycles=args.cycles,
                         nodes=args.nodes, arrival_rate=args.rate,
                         fail_fraction=args.fail_fraction)
-    workload = Workload.load(args.trace) if args.trace else Workload(spec)
+    conf = None
+    if args.trace:
+        workload = Workload.load(args.trace)
+    elif args.preset:
+        workload = WORKLOAD_PRESETS[args.preset](
+            seed=args.seed, cycles=args.cycles, nodes=args.nodes)
+        # defrag A/B arms share the binpack conf (see sim/__main__.py)
+        from ..sim.virtualcluster import BINPACK_CONF
+        conf = BINPACK_CONF
+    else:
+        workload = Workload(spec)
+    reschedule = None
+    if args.reschedule_interval > 0:
+        reschedule = {
+            "interval": args.reschedule_interval,
+            "max_moves": args.reschedule_max_moves,
+            "max_disruption_per_job": args.reschedule_max_disruption,
+        }
 
     if args.verify:
         rep = sim_replay.verify(args.verify, workload=workload,
                                 cycles=args.cycles, mode=args.mode,
                                 drain=args.drain,
                                 solver_mode=args.solver_mode,
-                                sharded_byte_budget=args.sharded_byte_budget)
+                                sharded_byte_budget=args.sharded_byte_budget,
+                                scheduler_conf=conf,
+                                reschedule=reschedule)
         status = "replay OK (byte-identical)" if rep["ok"] \
             else "replay DIVERGED"
         out = [f"{status}: {rep['cycles']} cycles, digest {rep['digest']}"]
@@ -332,12 +351,17 @@ def sim_cmd(args, cluster: ClusterStore) -> str:
                                 mode=args.mode, drain=args.drain,
                                 record_path=args.record,
                                 solver_mode=args.solver_mode,
-                                sharded_byte_budget=args.sharded_byte_budget)
+                                sharded_byte_budget=args.sharded_byte_budget,
+                                scheduler_conf=conf,
+                                reschedule=reschedule)
     sc = result.score
     out = [
         f"sim: {sc['cycles']} cycles, mode={args.mode}, seed={args.seed}",
         f"jobs: {sc['jobs_arrived']} arrived, {sc['jobs_served']} served, "
         f"{sc['jobs_completed']} completed; {sc['pods_bound']} pods bound",
+        f"fragmentation: index {sc['fragmentation_index']}, largest free "
+        f"slot {sc['largest_free_slot_mean']}; {sc['migrations']} "
+        f"migrations (churn {sc['migration_churn']})",
         f"digest: {result.digest}",
     ]
     # the aggregated FitErrors summaries ("x/y tasks unschedulable: ...")
@@ -433,6 +457,18 @@ def build_parser() -> argparse.ArgumentParser:
     simp.add_argument("--record", metavar="PATH", default=None)
     simp.add_argument("--verify", metavar="PATH", default=None)
     simp.add_argument("--trace", metavar="PATH", default=None)
+    simp.add_argument("--preset", default=None, choices=["fragmented"],
+                      help="named seeded workload preset (the fragmented "
+                           "500-cycle defrag baseline)")
+    simp.add_argument("--reschedule-interval", type=int, default=0,
+                      metavar="N",
+                      help="enable the global rescheduler: defrag solve "
+                           "every N cycles (0 = off)")
+    simp.add_argument("--reschedule-max-moves", type=int, default=8,
+                      help="migration budget per defrag plan")
+    simp.add_argument("--reschedule-max-disruption-per-job", type=int,
+                      default=1, dest="reschedule_max_disruption",
+                      help="PDB-style per-job disruption cap per plan")
 
     sub.add_parser("version")
     return p

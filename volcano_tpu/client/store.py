@@ -26,6 +26,7 @@ KINDS = (
     "pods", "nodes", "podgroups", "queues", "priorityclasses",
     "resourcequotas", "jobs", "commands", "services", "configmaps",
     "secrets", "pvcs", "leases", "networkpolicies", "bindintents",
+    "migrationintents",
 )
 
 

@@ -328,6 +328,32 @@ job_retry_total = registry.register(Counter(
     "Job controller re-enqueues after a failed sync (capped exponential "
     "backoff per job key)", ["job_id"]))
 
+# -- global rescheduler metrics (reschedule/) -------------------------------
+
+reschedule_plans_total = registry.register(Counter(
+    "volcano_reschedule_plans_total",
+    "Defragmentation plans by outcome: executed, pre-solve skips "
+    "(empty / fits / no_hole / skipped_breaker / solve_failed) and "
+    "post-solve plan rejections (rejected_no_gain / rejected_no_hole / "
+    "rejected_fits / rejected_empty / rejected_budget)", ["outcome"]))
+reschedule_moves_total = registry.register(Counter(
+    "volcano_reschedule_moves_total",
+    "Migration moves by stage (proposed = raw solved-vs-incumbent diff, "
+    "selected = survived budget/caps/feasibility, executed = evictions "
+    "dispatched, capped = cut by bounding)", ["stage"]))
+reschedule_fragmentation = registry.register(Gauge(
+    "volcano_reschedule_fragmentation",
+    "Stranded-free-capacity fraction at the last plan (pre = measured, "
+    "post = projected over the selected moves)", ["phase"]))
+reschedule_plan_solve_ms = registry.register(Gauge(
+    "volcano_reschedule_plan_solve_milliseconds",
+    "Wall time of the last defrag solve (snapshot + flatten + device "
+    "solve + readback)"))
+reschedule_intents_total = registry.register(Counter(
+    "volcano_reschedule_intents_total",
+    "Migration-intent journal activity (recorded / confirmed / settled "
+    "/ abandoned)", ["event"]))
+
 # -- cluster simulator metrics (sim/) ---------------------------------------
 
 sim_cycles_total = registry.register(Counter(

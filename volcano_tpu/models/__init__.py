@@ -14,7 +14,7 @@ from .core import (  # noqa: F401
     PriorityClass, ResourceQuota, Secret, Service, new_uid,
 )
 from .scheduling import (  # noqa: F401
-    BindIntent,
+    BindIntent, MigrationIntent,
     PodGroup, PodGroupCondition, PodGroupPhase, PodGroupSpec, PodGroupStatus,
     Queue, QueueSpec, QueueState, QueueStatus,
     POD_GROUP_UNSCHEDULABLE_TYPE, POD_GROUP_SCHEDULED_TYPE,

@@ -99,6 +99,26 @@ class BindIntent:
     resource_version: int = 0
 
 
+@dataclass
+class MigrationIntent:
+    """Durable record of one rescheduler migration wave, written BEFORE
+    the wave's evictions dispatch (reschedule/intent.py). ``moves`` is
+    the decided [namespace, pod, from_node, to_node] quadruple list —
+    the eviction set plus the solver's advisory targets. Unlike a
+    BindIntent, recovery never re-drives these: a wave whose evictions
+    the crash swallowed is ABANDONED (the next reschedule pass re-solves
+    against fresh state), so a half-executed plan can only under-migrate,
+    never double-evict. Cluster-scoped, like BindIntent."""
+
+    name: str
+    moves: List[List[str]] = field(default_factory=list)
+    holder: str = ""
+    epoch: int = 0
+    created: float = 0.0
+    uid: str = field(default_factory=lambda: new_uid("mi"))
+    resource_version: int = 0
+
+
 class QueueState(str, enum.Enum):
     OPEN = "Open"
     CLOSED = "Closed"

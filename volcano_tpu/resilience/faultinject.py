@@ -39,7 +39,13 @@ window), ``bind_commit`` (framework/statement commit / bulk flush, after
 the bind-intent journal write and before any cache bind effect — arming
 ``at:1`` crashes pre-commit with the intent durable but nothing applied;
 ``at:2`` crashes mid-dispatch with one statement's binds applied and the
-rest only journaled).
+rest only journaled), ``reschedule_dispatch`` (reschedule/action.py,
+before the defrag solve dispatches — a failure counts one breaker
+failure and skips the pass), and ``migration_commit``
+(reschedule/action.py, per migration wave, after the wave's
+migration-intent write and before its evictions dispatch — ``at:1``
+crashes with the first wave journaled but zero evictions applied,
+``at:2`` with wave one fully evicted and wave two only journaled).
 """
 
 from __future__ import annotations

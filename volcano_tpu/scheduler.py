@@ -38,7 +38,11 @@ class Scheduler:
                  breaker_failures: int = 3,
                  breaker_cooldown_s: float = 30.0,
                  solver_mode: Optional[str] = None,
-                 sharded_byte_budget: int = 0):
+                 sharded_byte_budget: int = 0,
+                 reschedule_interval: int = 0,
+                 reschedule_max_moves: Optional[int] = None,
+                 reschedule_max_disruption: Optional[int] = None,
+                 reschedule_min_improvement: Optional[float] = None):
         # adaptive host-loop node sampling knob, instance-scoped
         # (cmd/scheduler/app/options/options.go:37-40)
         from .utils import NodeSampler
@@ -75,6 +79,27 @@ class Scheduler:
             cache.solver_mode = solver_mode
         if sharded_byte_budget:
             cache.sharded_byte_budget = int(sharded_byte_budget)
+        # --reschedule-* deployment flags: a positive interval opts the
+        # global rescheduler in without a conf edit (load_conf appends the
+        # action when the conf's actions string doesn't name it); the
+        # bounding knobs become the action's defaults, per-action conf
+        # arguments still win (reschedule/action.py DEFAULTS)
+        self._reschedule_enabled = reschedule_interval > 0
+        if self._reschedule_enabled or reschedule_max_moves is not None \
+                or reschedule_max_disruption is not None \
+                or reschedule_min_improvement is not None:
+            opts = dict(getattr(cache, "reschedule_opts", None) or {})
+            if reschedule_interval > 0:
+                opts["interval"] = int(reschedule_interval)
+            if reschedule_max_moves is not None:
+                opts["max_moves"] = int(reschedule_max_moves)
+            if reschedule_max_disruption is not None:
+                opts["max_disruption_per_job"] = \
+                    int(reschedule_max_disruption)
+            if reschedule_min_improvement is not None:
+                opts["min_improvement"] = float(reschedule_min_improvement)
+            cache.reschedule_opts = opts
+            self.load_conf()  # re-apply: the first load ran pre-flag
         # compile-and-dispatch pipeline (ops.precompile): persistent
         # on-disk XLA executable cache (explicit dir or
         # $VOLCANO_COMPILE_CACHE_DIR), background next-bucket pre-warm,
@@ -127,6 +152,15 @@ class Scheduler:
         self.actions = acts
         self.tiers = conf.tiers
         self.configurations = conf.configurations
+        # --reschedule-interval opt-in: append the rescheduler when the
+        # conf's actions string doesn't name it (and keep it appended
+        # across hot reloads); a conf that DOES name `reschedule` places
+        # it explicitly and is left alone
+        if getattr(self, "_reschedule_enabled", False) \
+                and all(a.name() != "reschedule" for a in self.actions):
+            resched = get_action("reschedule")
+            if resched is not None:
+                self.actions = list(self.actions) + [resched]
 
     # -- the loop -----------------------------------------------------------
 
@@ -451,13 +485,19 @@ class Scheduler:
             if elector.is_leader:
                 if not was_leader:
                     # takeover: settle the dead leader's journaled binds
-                    # before scheduling anything
+                    # before scheduling anything, then settle-or-abandon
+                    # its in-flight migration waves (reschedule/intent.py:
+                    # swallowed evictions are ABANDONED, never re-driven)
                     try:
                         reconcile_bind_intents(self.cache.cluster,
                                                elector.fencing_token)
+                        from .reschedule import reconcile_migration_intents
+                        reconcile_migration_intents(self.cache.cluster,
+                                                    elector.fencing_token)
                     except Exception:
-                        log.exception("bind-intent recovery failed; "
-                                      "retrying before the first cycle")
+                        log.exception("bind/migration-intent recovery "
+                                      "failed; retrying before the first "
+                                      "cycle")
                         stop.wait(0.05)
                         continue
                     self.cache.bind_journal = journal

@@ -47,6 +47,20 @@ def main(argv=None) -> int:
                     help="verify against a golden trace at PATH")
     ap.add_argument("--trace", metavar="PATH",
                     help="load the workload from a JSONL trace")
+    ap.add_argument("--preset", default=None,
+                    choices=["fragmented"],
+                    help="named seeded workload preset (overrides the "
+                         "generator knobs; --seed/--cycles/--nodes still "
+                         "apply)")
+    ap.add_argument("--reschedule-interval", type=int, default=0,
+                    metavar="N",
+                    help="enable the global rescheduler: run the defrag "
+                         "solve every N cycles (0 = off)")
+    ap.add_argument("--reschedule-max-moves", type=int, default=8,
+                    help="migration budget per defrag plan")
+    ap.add_argument("--reschedule-max-disruption-per-job", type=int,
+                    default=1, dest="reschedule_max_disruption",
+                    help="PDB-style per-job disruption cap per plan")
     ap.add_argument("--emit-workload", metavar="PATH",
                     help="write the generated workload trace and exit")
     ap.add_argument("--quiet", action="store_true",
@@ -54,15 +68,33 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from .replay import run_sim, verify
-    from .workload import Workload, WorkloadSpec
+    from .workload import WORKLOAD_PRESETS, Workload, WorkloadSpec
 
     spec = WorkloadSpec(seed=args.seed, cycles=args.cycles,
                         nodes=args.nodes, arrival_rate=args.rate,
                         gang_max=args.gang_max,
                         duration_max=args.duration_max,
                         fail_fraction=args.fail_fraction)
-    workload = Workload.load(args.trace) if args.trace \
-        else Workload(spec)
+    conf = None
+    if args.trace:
+        workload = Workload.load(args.trace)
+    elif args.preset:
+        workload = WORKLOAD_PRESETS[args.preset](
+            seed=args.seed, cycles=args.cycles, nodes=args.nodes)
+        # both arms of a defrag A/B run the binpack conf: the baseline
+        # must already pack as well as the scorer can, so the reschedule
+        # gain measures un-done HISTORY, not a handicapped allocate
+        from .virtualcluster import BINPACK_CONF
+        conf = BINPACK_CONF
+    else:
+        workload = Workload(spec)
+    reschedule = None
+    if args.reschedule_interval > 0:
+        reschedule = {
+            "interval": args.reschedule_interval,
+            "max_moves": args.reschedule_max_moves,
+            "max_disruption_per_job": args.reschedule_max_disruption,
+        }
 
     if args.emit_workload:
         workload.save(args.emit_workload)
@@ -74,13 +106,15 @@ def main(argv=None) -> int:
     if args.verify:
         rep = verify(args.verify, workload=workload, cycles=args.cycles,
                      mode=args.mode, drain=args.drain,
-                     preempt=args.preempt)
+                     preempt=args.preempt, scheduler_conf=conf,
+                     reschedule=reschedule)
         print(json.dumps(rep, sort_keys=True))
         return 0 if rep["ok"] else 2
 
     result = run_sim(workload=workload, cycles=args.cycles,
                      mode=args.mode, drain=args.drain,
-                     preempt=args.preempt, record_path=args.record)
+                     preempt=args.preempt, record_path=args.record,
+                     scheduler_conf=conf, reschedule=reschedule)
     if not args.quiet:
         for line in result.lines:
             print(line)

@@ -33,15 +33,19 @@ def run_sim(spec: Optional[WorkloadSpec] = None, cycles: int = 100,
             scheduler_conf: Optional[str] = None, preempt: bool = False,
             record_path: Optional[str] = None,
             solver_mode: Optional[str] = None,
-            sharded_byte_budget: int = 0) -> SimResult:
+            sharded_byte_budget: int = 0,
+            reschedule: Optional[dict] = None) -> SimResult:
     """One full sim run. ``workload`` overrides ``spec`` (external
     traces); ``drain`` allows extra cycles for in-flight jobs to finish
-    so makespan/conservation are meaningful."""
+    so makespan/conservation are meaningful; ``reschedule`` (a dict of
+    interval / max_moves / max_disruption_per_job / min_improvement)
+    enables the global rescheduler action."""
     wl = workload if workload is not None \
         else Workload(spec or WorkloadSpec())
     vc = VirtualCluster(wl, mode=mode, scheduler_conf=scheduler_conf,
                         preempt=preempt, solver_mode=solver_mode,
-                        sharded_byte_budget=sharded_byte_budget)
+                        sharded_byte_budget=sharded_byte_budget,
+                        reschedule=reschedule)
     lines = vc.run(cycles, drain=drain)
     sc = score_mod.compute(vc.stats, cycles=len(lines), dt=vc.dt)
     if record_path:

@@ -9,8 +9,14 @@ metrics are the ones the cluster-trace literature regresses:
 - node utilization (mean fraction of allocatable CPU in use per cycle);
 - Jain fairness index across queues over weight-normalized service
   (cpu-time integrated over the run): 1.0 = perfectly weighted-fair;
-- preemption churn (evictions per successful bind) and failure/replace
-  counts.
+- preemption churn (non-migration evictions per successful bind) and
+  failure/replace counts;
+- fragmentation: the per-cycle stranded-free-capacity fraction (free
+  CPU sitting on nodes too full to fit the workload's largest task
+  shape; reschedule/plan.py stranded_fraction) averaged over the run,
+  the mean largest-free-slot fraction, and migration churn (rescheduler
+  evictions per successful bind) — the series the reschedule action's
+  defrag gain is judged on.
 """
 
 from __future__ import annotations
@@ -68,7 +74,13 @@ def compute(stats: dict, cycles: int, dt: float = 1.0) -> dict:
     jfi = jain_fairness(norm_shares)
 
     binds = stats["binds"]
-    churn = stats["evictions"] / binds if binds else 0.0
+    migrations = stats.get("migrations", 0)
+    # preemption churn counts preempt/reclaim victims only; the
+    # rescheduler's deliberate migrations get their own column
+    churn = (stats["evictions"] - migrations) / binds if binds else 0.0
+
+    frag = stats.get("frag_samples") or []
+    largest = stats.get("largest_free_samples") or []
 
     r = {
         "jobs_arrived": len(arrive),
@@ -83,6 +95,12 @@ def compute(stats: dict, cycles: int, dt: float = 1.0) -> dict:
         "utilization_mean": round(mean_util, 6),
         "jfi_queues": round(jfi, 6),
         "preemption_churn": round(churn, 6),
+        "fragmentation_index": round(sum(frag) / len(frag), 6)
+        if frag else 0.0,
+        "largest_free_slot_mean": round(sum(largest) / len(largest), 6)
+        if largest else 0.0,
+        "migrations": migrations,
+        "migration_churn": round(migrations / binds, 6) if binds else 0.0,
         "evictions": stats["evictions"],
         "evictions_finalized": stats["evictions_finalized"],
         "failures": stats["failures"],

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import heapq
 import logging
+import re
 from typing import Dict, List, Optional
 
 from ..api import Resource
@@ -77,29 +78,71 @@ class VirtualEvictor(DefaultEvictor):
         self.cluster.update("pods", pod)
 
 
+#: the default conf with the binpack scorer in the second tier: the conf
+#: both arms of a defrag A/B run (the baseline must already pack as well
+#: as the scorer can — the rescheduler's gain is un-doing HISTORY, not
+#: compensating for a spread-scoring allocate)
+BINPACK_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: binpack
+  - name: nodeorder
+"""
+
+
 def build_conf(mode: str = "solver", preempt: bool = False,
-               base: Optional[str] = None) -> str:
+               base: Optional[str] = None,
+               reschedule: Optional[dict] = None) -> str:
     """Scheduler conf for a sim run: the default conf with the allocate
-    execution mode pinned (solver/host/sequential/sharded) and optionally
-    the preempt action enabled."""
+    execution mode pinned (solver/host/sequential/sharded), optionally
+    the preempt action enabled, and optionally the reschedule action
+    appended with its bounding arguments (``reschedule`` is a dict with
+    any of interval / max_moves / max_disruption_per_job /
+    min_improvement)."""
     text = base if base is not None else DEFAULT_SCHEDULER_CONF
     if preempt and "preempt" not in text:
         text = text.replace(
             'actions: "enqueue, allocate, backfill"',
             'actions: "enqueue, allocate, preempt, backfill"')
+    blocks = []
     if mode not in (None, "", "solver"):
-        if "configurations:" in text:
-            raise ValueError(
-                "build_conf cannot pin a mode on a conf that already has "
-                "a configurations block; pass the full conf instead")
-        block = ("configurations:\n"
-                 "- name: allocate\n"
+        block = ("- name: allocate\n"
                  f"  arguments:\n    mode: {mode}\n")
         if mode == "host":
             for act in ("preempt", "reclaim"):
                 block += (f"- name: {act}\n"
                           "  arguments:\n    mode: host\n")
-        text = text + "\n" + block
+        blocks.append(block)
+    if reschedule:
+        m = re.search(r'(actions:\s*"[^"]*)"', text)
+        if m and "reschedule" not in m.group(1):
+            text = text[:m.end(1)] + ", reschedule" + text[m.end(1):]
+        args = {
+            "reschedule.interval": reschedule.get("interval", 10),
+            "reschedule.maxMoves": reschedule.get("max_moves", 8),
+            "reschedule.maxDisruptionPerJob":
+                reschedule.get("max_disruption_per_job", 1),
+            "reschedule.minImprovement":
+                reschedule.get("min_improvement", 0.01),
+        }
+        block = "- name: reschedule\n  arguments:\n"
+        for k, v in args.items():
+            block += f"    {k}: {v}\n"
+        blocks.append(block)
+    if blocks:
+        if "configurations:" in text:
+            raise ValueError(
+                "build_conf cannot add configurations to a conf that "
+                "already has a configurations block; pass the full conf "
+                "instead")
+        text = text + "\nconfigurations:\n" + "".join(blocks)
     return text
 
 
@@ -112,7 +155,8 @@ class VirtualCluster:
                  grace_cycles: int = 2, preempt: bool = False,
                  recorder: Optional[DecisionRecorder] = None,
                  solver_mode: Optional[str] = None,
-                 sharded_byte_budget: int = 0):
+                 sharded_byte_budget: int = 0,
+                 reschedule: Optional[dict] = None):
         self.workload = workload
         self.dt = float(dt)
         self.clock = VirtualClock()
@@ -144,7 +188,8 @@ class VirtualCluster:
         self.sched = Scheduler(
             self.cache,
             scheduler_conf=build_conf(mode, preempt=preempt,
-                                      base=scheduler_conf))
+                                      base=scheduler_conf,
+                                      reschedule=reschedule))
 
         # cluster objects (distinct virtual creation timestamps)
         for q in workload.queue_objects():
@@ -156,6 +201,9 @@ class VirtualCluster:
         self._alloc_mcpu = sum(
             Resource.from_resource_list(n.allocatable).milli_cpu
             for n in workload.node_objects())
+        # fragmentation reference slot: the workload's largest task shape
+        # (free CPU on nodes that can't fit it counts as stranded)
+        self._frag_ref = max(workload.spec.cpu_choices or (1,)) * 1000.0
 
         # lifecycle state
         self._cycle = 0
@@ -163,6 +211,7 @@ class VirtualCluster:
         self._heap_seq = 0
         self._obj_seq = 0              # per-tick creation-timestamp spread
         self._running: Dict[str, tuple] = {}   # key -> (Resource, job, q)
+        self._bind_time: Dict[str, float] = {}  # key -> virtual bind time
         self._expected_delete: set = set()
         self._replaced: Dict[str, int] = {}    # base pod name -> count
         self._job_pods: Dict[str, set] = {}    # jobkey -> pod keys ever
@@ -173,9 +222,10 @@ class VirtualCluster:
             "job_size": {}, "min_member": {}, "queue_of": {},
             "bound_count": {}, "completed_count": {},
             "binds": 0, "evictions": 0, "evictions_finalized": 0,
-            "failures": 0,
+            "failures": 0, "migrations": 0,
             "bound_mcpu": 0.0, "released_mcpu": 0.0,
-            "util_samples": [],
+            "util_samples": [], "frag_samples": [],
+            "largest_free_samples": [],
             "queue_running_mcpu": {}, "queue_service": {},
             "queue_weight": {q: w for q, w in workload.spec.queues},
         }
@@ -198,6 +248,7 @@ class VirtualCluster:
                   f"{pod.annotations.get(POD_GROUP_ANNOTATION, '')}")
         queue = st["queue_of"].get(jobkey, "default")
         self._running[key] = (req, jobkey, queue)
+        self._bind_time[key] = now
         st["binds"] += 1
         st["bound_mcpu"] += req.milli_cpu
         st["queue_running_mcpu"][queue] = \
@@ -215,9 +266,13 @@ class VirtualCluster:
             self._push(now + duration * self.dt, "complete", key)
 
     def _on_evict(self, pod, reason: str) -> None:
+        from ..reschedule import MIGRATION_REASON
+
         key = f"{pod.namespace}/{pod.name}"
         self.recorder.record_evict(key, reason)
         self.stats["evictions"] += 1
+        if reason.startswith(MIGRATION_REASON):
+            self.stats["migrations"] += 1
 
     def _push(self, due: float, kind: str, key: str) -> None:
         self._heap_seq += 1
@@ -225,6 +280,7 @@ class VirtualCluster:
 
     def _release(self, key: str) -> None:
         ent = self._running.pop(key, None)
+        self._bind_time.pop(key, None)
         if ent is None:
             return
         req, jobkey, queue = ent
@@ -233,17 +289,23 @@ class VirtualCluster:
         st["queue_running_mcpu"][queue] = \
             st["queue_running_mcpu"].get(queue, 0.0) - req.milli_cpu
 
-    def _replacement(self, pod, drop_fail: bool = True) -> Pod:
+    def _replacement(self, pod, drop_fail: bool = True,
+                     resume_duration: Optional[int] = None) -> Pod:
         """The job controller's recreate semantics: a failed or evicted
         pod comes back as a fresh Pending pod of the same gang. The fail
         annotation is dropped (a task fails once), so replacement chains
-        terminate deterministically."""
+        terminate deterministically. ``resume_duration`` overrides the
+        replacement's run time: a PLANNED migration (reschedule eviction)
+        resumes from checkpoint instead of redoing the work — failures
+        and preemptions keep full-restart semantics."""
         base = pod.name.split("-r")[0]
         n = self._replaced.get(base, 0) + 1
         self._replaced[base] = n
         ann = dict(pod.annotations)
         if drop_fail:
             ann.pop(FAIL_AFTER_ANNOTATION, None)
+        if resume_duration is not None:
+            ann[DURATION_ANNOTATION] = str(int(resume_duration))
         self._obj_seq += 1
         repl = Pod(name=f"{base}-r{n}", namespace=pod.namespace,
                    annotations=ann, containers=pod.containers,
@@ -265,11 +327,27 @@ class VirtualCluster:
             return
         if obj.deletion_timestamp is not None and key in self._running:
             # an evicted pod the virtual kubelet just finalized: release
-            # its resources and feed the replacement back as new work
+            # its resources and feed the replacement back as new work.
+            # A reschedule-reason eviction is a planned migration of a
+            # checkpointed task: the replacement resumes with the
+            # remaining duration (progress accrued until the eviction
+            # was stamped), so the migration's cost is the grace +
+            # requeue disruption, not lost work. Preemptions restart.
+            resume = None
+            if any(c.get("reason") == "Evict"
+                   and str(c.get("message", "")).startswith("reschedule")
+                   for c in obj.conditions or []):
+                bind_t = self._bind_time.get(key)
+                if bind_t is not None:
+                    dur = int(obj.annotations.get(DURATION_ANNOTATION,
+                                                  "5"))
+                    ran = int(max(0.0, obj.deletion_timestamp - bind_t)
+                              / self.dt)
+                    resume = max(1, dur - ran)
             self._release(key)
             self.stats["evictions_finalized"] += 1
             self.recorder.record_event("evict_finalized", key)
-            repl = self._replacement(obj)
+            repl = self._replacement(obj, resume_duration=resume)
             self.store.create("pods", repl)
             self.recorder.record_event(
                 "replace", f"{repl.namespace}/{repl.name}")
@@ -375,10 +453,21 @@ class VirtualCluster:
         return line
 
     def _sample(self) -> None:
+        from ..reschedule import stranded_fraction
+
         st = self.stats
         used = sum(ni.used.milli_cpu for ni in self.cache.nodes.values())
         st["util_samples"].append(
             used / self._alloc_mcpu if self._alloc_mcpu else 0.0)
+        free = [ni.idle.milli_cpu for ni in self.cache.nodes.values()
+                if ni.node is not None]
+        st["frag_samples"].append(
+            stranded_fraction(free, self._frag_ref))
+        cap = max((ni.allocatable.milli_cpu
+                   for ni in self.cache.nodes.values()
+                   if ni.node is not None), default=0.0)
+        st["largest_free_samples"].append(
+            max(free) / cap if free and cap else 0.0)
         for q, mcpu in st["queue_running_mcpu"].items():
             st["queue_service"][q] = \
                 st["queue_service"].get(q, 0.0) + mcpu * self.dt

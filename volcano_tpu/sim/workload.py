@@ -211,6 +211,77 @@ class Workload:
         return cls(spec, events=events)
 
 
+def fragmented_workload(seed: int = 0, cycles: int = 500,
+                        nodes: int = 9) -> Workload:
+    """Seeded ``fragmented`` preset: the workload the rescheduler's
+    defrag gain is judged on (ISSUE 8 / ROADMAP item 5).
+
+    Three deterministic components interleave on ``nodes`` x 32-cpu
+    nodes. The component rates are absolute, so ``nodes`` sets the
+    operating point: the default 9 lands at ~0.80 mean utilization —
+    fragmented (the 16-cpu shape regularly fits nowhere, bigs queue)
+    but with landing capacity left for migrations, which is the regime
+    defragmentation exists for. 6 nodes saturates (~0.88, no landing
+    room); 12 idles (~0.69, nothing queues long enough to matter).
+
+    - **long-running gangs** (cpu 8, gang 2-3, 60-140 cycles) arriving
+      every few cycles — the placements that pin history;
+    - **high-churn short jobs** (cpu 1-2, gang 1-2, 2-6 cycles, Poisson
+      ~4/cycle) constantly opening and closing holes around them;
+    - **big periodic jobs** (cpu 16, 10-20 cycles) — the fragmentation
+      victims: once the longs are scattered, plenty of total free CPU
+      sits stranded in sub-16 slots and the bigs queue.
+
+    Same seed => byte-identical trace; the no-reschedule run of this
+    workload is the golden baseline the reschedule-enabled run must beat
+    on utilization and fragmentation_index with wait p99 no worse
+    (tests/test_reschedule.py, bench.py reschedule_defrag).
+    """
+    spec = WorkloadSpec(
+        seed=seed, cycles=cycles, nodes=nodes, node_cpu="32",
+        node_mem="128Gi", queues=(("q0", 1), ("q1", 2)),
+        arrival_rate=4.0, gang_min=1, gang_max=3,
+        cpu_choices=(1, 2, 4, 8, 16), mem_gi_choices=(1, 2, 4),
+        duration_min=2, duration_max=140)
+    rng = random.Random(seed ^ 0xF4A6)
+    qnames = [q for q, _ in spec.queues]
+    events: List[dict] = []
+    seq = 0
+
+    def emit(t, gang, cpu, mem_gi, dur_lo, dur_hi, tag):
+        nonlocal seq
+        tasks = [{"cpu": str(cpu), "memory": f"{mem_gi}Gi", "gpu": 0,
+                  "duration": rng.randint(dur_lo, dur_hi),
+                  "fail_after": None} for _ in range(gang)]
+        events.append({"t": t, "kind": "job",
+                       "name": f"{tag}{seq}",
+                       "namespace": spec.namespace,
+                       "queue": qnames[seq % len(qnames)],
+                       "min_member": gang, "priority_class": "",
+                       "tasks": tasks})
+        seq += 1
+
+    for t in range(cycles):
+        if t % 8 == 0:
+            # long-running gang: the fragment-pinning component
+            emit(t, rng.randint(2, 3), 8, 4, 50, 110, "long")
+        if t % 4 == 2:
+            # big single-node job: needs one mostly-free node — the
+            # fragmentation victim the defrag gain is measured on
+            emit(t, 1, 16, 4, 15, 30, "big")
+        for _ in range(_poisson(rng, 4.0)):
+            emit(t, rng.randint(1, 2), rng.choice((1, 1, 2)),
+                 rng.choice((1, 2)), 2, 6, "churn")
+    return Workload(spec, events=events)
+
+
+#: named presets accepted by `vcctl sim --preset` / `python -m
+#: volcano_tpu.sim --preset`; each returns a fully-seeded Workload
+WORKLOAD_PRESETS = {
+    "fragmented": fragmented_workload,
+}
+
+
 def build_job_crd(ev: dict):
     """One arrival event as a volcano Job CRD — the ``standalone
     --sim-trace`` path, where arrivals must take the full admission +
