@@ -54,7 +54,11 @@ class Standalone:
                  sim_record: Optional[str] = None,
                  sim_trace: Optional[str] = None,
                  solver_mode: Optional[str] = None,
-                 sharded_byte_budget: int = 0):
+                 sharded_byte_budget: int = 0,
+                 reschedule_interval: int = 0,
+                 reschedule_max_moves: Optional[int] = None,
+                 reschedule_max_disruption: Optional[int] = None,
+                 reschedule_min_improvement: Optional[float] = None):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -193,7 +197,11 @@ class Standalone:
             breaker_failures=breaker_failures,
             breaker_cooldown_s=breaker_cooldown_s,
             solver_mode=solver_mode,
-            sharded_byte_budget=sharded_byte_budget)
+            sharded_byte_budget=sharded_byte_budget,
+            reschedule_interval=reschedule_interval,
+            reschedule_max_moves=reschedule_max_moves,
+            reschedule_max_disruption=reschedule_max_disruption,
+            reschedule_min_improvement=reschedule_min_improvement)
         # pipeline_effects: don't drain the async bind effectors between
         # control-plane turns — cycle N's API writes overlap cycle N+1's
         # snapshot+flatten (see Scheduler.run). Off by default: embedding
@@ -367,6 +375,29 @@ def main(argv=None) -> int:
                          "--solver-mode auto (default 256 MiB; the first "
                          "session always runs packed — no layout has "
                          "been measured yet)")
+    ap.add_argument("--reschedule-interval", type=int, default=0,
+                    metavar="N",
+                    help="enable the global rescheduler without a conf "
+                         "edit: run the device-solved defrag pass every "
+                         "N scheduling cycles (0 = off; a conf naming "
+                         "the reschedule action places it explicitly). "
+                         "Conf-file equivalent: reschedule.interval in "
+                         "the action's configurations block")
+    ap.add_argument("--reschedule-max-moves", type=int, default=None,
+                    metavar="K",
+                    help="migration budget per defrag plan (default 8; "
+                         "conf: reschedule.maxMoves)")
+    ap.add_argument("--reschedule-max-disruption-per-job", type=int,
+                    default=None, metavar="K",
+                    dest="reschedule_max_disruption",
+                    help="PDB-style per-job disruption cap per plan "
+                         "(default 1; conf: reschedule.maxDisruptionPerJob)")
+    ap.add_argument("--reschedule-min-improvement", type=float,
+                    default=None, metavar="FRAC",
+                    dest="reschedule_min_improvement",
+                    help="minimum stranded-fraction improvement below "
+                         "which a plan is rejected as no-op churn "
+                         "(default 0.01; conf: reschedule.minImprovement)")
     args = ap.parse_args(argv)
 
     conf = None
@@ -394,7 +425,11 @@ def main(argv=None) -> int:
                     sim_record=args.sim_record,
                     sim_trace=args.sim_trace,
                     solver_mode=args.solver_mode,
-                    sharded_byte_budget=args.sharded_byte_budget)
+                    sharded_byte_budget=args.sharded_byte_budget,
+                    reschedule_interval=args.reschedule_interval,
+                    reschedule_max_moves=args.reschedule_max_moves,
+                    reschedule_max_disruption=args.reschedule_max_disruption,
+                    reschedule_min_improvement=args.reschedule_min_improvement)
     if args.jobs_dir:
         import glob
         import os
