@@ -52,6 +52,14 @@ SIGKILLed mid-wave; records takeover latency (kill -> first standby
 bind, and lease-expiry -> first bind) and the first-post-takeover
 cycle's solve time + session-thread compile count, WARM standby
 (shadow cycles) vs COLD as an A/B.
+
+``store_durability`` closes the crash ladder at the store itself: WAL
+churn overhead per fsync policy (single-op vs bulk batches), recovery
+time vs journal length, and the kill-9 store soak — the durable store
+PROCESS SIGKILLed with a wave committed but unbound, restarted on the
+same port + data dir, decision trace asserted bind-for-bind identical
+to an uninterrupted golden run with every watcher resuming via
+``since:``.
 """
 
 from __future__ import annotations
@@ -1893,6 +1901,120 @@ def reschedule_defrag():
     return out
 
 
+def store_durability():
+    """The durable-store acceptance config (ISSUE 9): (a) churn overhead
+    of the WAL vs the in-memory store, per fsync policy, single-op vs
+    bulk_apply batches; (b) recovery time vs journal length (pure-WAL
+    replay and snapshot+tail); (c) the kill-9 store soak — a durable
+    store PROCESS SIGKILLed with a wave's pods committed but unbound,
+    restarted on the same port + data dir, scheduler + controllers
+    riding through on retry + ``since:`` watch resume — with the
+    decision trace compared bind-for-bind to an uninterrupted golden
+    run. ``ok`` asserts the soak trio: identical trace, zero lost/dup
+    binds, zero crash-only resyncs."""
+    import os
+    import shutil
+    import tempfile
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from helpers import build_pod
+    from volcano_tpu.client import ClusterStore, DurableClusterStore
+
+    out = {}
+    work = tempfile.mkdtemp(prefix="volcano-store-bench-")
+    try:
+        # -- (a) churn overhead: create/update/delete cycles ------------
+        n_ops = 300
+
+        def churn(store):
+            t0 = time.perf_counter()
+            for i in range(n_ops // 3):
+                pod = build_pod("bench", f"p{i}", "", "Pending",
+                                {"cpu": "1"}, "pg")
+                store.create("pods", pod)
+                pod.node_name = "n0"
+                store.update("pods", pod)
+                store.delete("pods", f"p{i}", "bench")
+            return (n_ops // 3) * 3 / (time.perf_counter() - t0)
+
+        rates = {"memory": churn(ClusterStore())}
+        for policy in ("every", "interval", "off"):
+            rates[f"wal_{policy}"] = churn(DurableClusterStore(
+                os.path.join(work, f"churn-{policy}"), fsync=policy))
+        # bulk batches amortize the fsync: one sync per wave
+        bulk_store = DurableClusterStore(os.path.join(work, "churn-bulk"),
+                                         fsync="every")
+        t0 = time.perf_counter()
+        for w in range(6):
+            bulk_store.bulk_apply(
+                [("pods", build_pod("bench", f"w{w}-p{i}", "", "Pending",
+                                    {"cpu": "1"}, "pg"), "create")
+                 for i in range(50)])
+        rates["wal_every_bulk50"] = 300 / (time.perf_counter() - t0)
+        out["churn_ops_per_s"] = {k: round(v, 0) for k, v in rates.items()}
+        out["wal_overhead_x"] = {
+            k: round(rates["memory"] / v, 2)
+            for k, v in rates.items() if k != "memory"}
+
+        # -- (b) recovery time vs journal length ------------------------
+        recovery = {}
+        for n in (1000, 5000):
+            d = os.path.join(work, f"rec-{n}")
+            s = DurableClusterStore(d, fsync="off",
+                                    snapshot_every=10 ** 9)
+            for i in range(n):
+                s.apply("pods", build_pod("bench", f"p{i % 500}", "",
+                                          "Pending", {"cpu": "1"}, "pg"))
+            s.close()
+            s2 = DurableClusterStore(d)
+            recovery[f"wal_{n}_records_ms"] = round(s2.recovery_ms, 1)
+        # snapshot + short tail: the compacted steady-state shape
+        d = os.path.join(work, "rec-snap")
+        s = DurableClusterStore(d, fsync="off", snapshot_every=10 ** 9)
+        for i in range(5000):
+            s.apply("pods", build_pod("bench", f"p{i % 500}", "",
+                                      "Pending", {"cpu": "1"}, "pg"))
+        s.snapshot()
+        for i in range(100):
+            s.apply("pods", build_pod("bench", f"t{i}", "", "Pending",
+                                      {"cpu": "1"}, "pg"))
+        s.close()
+        s2 = DurableClusterStore(d)
+        recovery["snapshot_plus_100_tail_ms"] = round(s2.recovery_ms, 1)
+        recovery["snapshot_tail_records"] = s2.recovered_records
+        out["recovery"] = recovery
+
+        # -- (c) the kill-9 soak vs golden -------------------------------
+        from durable_soak import run_store_crash_soak
+        waves, kill_at = 5, 2
+        golden = run_store_crash_soak(os.path.join(work, "golden"),
+                                      waves=waves)
+        crash = run_store_crash_soak(os.path.join(work, "crash"),
+                                     waves=waves, kill_at_wave=kill_at)
+        identical = crash["binds_by_wave"] == golden["binds_by_wave"]
+        out["soak"] = {
+            "waves": waves, "kill_at_wave": kill_at,
+            "store_restart_s": crash["restart_s"],
+            "binds": crash["total_binds"],
+            "binds_identical_to_golden": bool(identical),
+            "lost_binds": crash["lost_binds"],
+            "dup_binds": crash["dup_binds"],
+            "watch_resumes": crash["watch_resumes"],
+            "crash_only_resyncs": crash["crash_only_resyncs"],
+            "scheduler_crashes": crash["crashes"],
+            "stalls": len(crash["stalls"]) + len(golden["stalls"]),
+        }
+        out["ok"] = bool(
+            identical
+            and crash["lost_binds"] == 0 and crash["dup_binds"] == 0
+            and crash["crashes"] == 0 and golden["crashes"] == 0
+            and crash["watch_resumes"] > 0
+            and crash["crash_only_resyncs"] == 0)
+        return out
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _transient_markers():
     """Shared with the in-scheduler dispatch retry
     (volcano_tpu.resilience.transient) so both layers agree on what
@@ -1956,6 +2078,7 @@ def _main_inner() -> dict:
         ("failover_ha", failover),
         ("sim_quality_500c", sim_quality),
         ("reschedule_defrag", reschedule_defrag),
+        ("store_durability", store_durability),
     ):
         configs[name] = _run_config(name, fn)
     setup_s = time.time() - t_setup

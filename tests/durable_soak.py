@@ -1,0 +1,271 @@
+"""Kill-9-the-store chaos soak, shared by tests/test_netstore.py and the
+``store_durability`` bench config.
+
+The last unprotected component of the crash ladder was the store itself:
+PR 5/8 proved the SCHEDULER can die anywhere, but every one of those
+proofs journals *into* the store. Here the store is a separate durable
+process (tests/store_server_proc.py) and the scheduler + controllers run
+in the driver against a RemoteClusterStore. Mid-churn the driver
+SIGKILLs the store — with a wave's pods committed but unbound — and
+starts a fresh process on the same port + data dir. Recovery replays the
+WAL; the clients ride through on the request-retry + watch-resume paths
+(``since:`` against the journal seeded from the recovered WAL tail, no
+crash-only resync); and the decision trace must stay bind-for-bind
+identical to an uninterrupted golden run: zero lost, zero duplicated
+binds.
+
+Wave protocol (one wave = one job generation, all deterministic):
+  submit Jobs -> controllers make the PodGroup (gated Pending) ->
+  scheduler enqueues it -> controllers bulk-create the pods ->
+  scheduler binds -> the wave's (pod, node) map is the decision record.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def start_store_proc(port: int, data_dir: str, fsync: str = "every",
+                     snapshot_every: int = 4096,
+                     timeout: float = 60.0) -> subprocess.Popen:
+    """Launch store_server_proc.py and wait for its READY line."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(TESTS_DIR, "store_server_proc.py"),
+         "--port", str(port), "--data-dir", data_dir,
+         "--fsync", fsync, "--snapshot-every", str(snapshot_every)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=os.path.dirname(TESTS_DIR))
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            return proc
+        if proc.poll() is not None:
+            break
+        time.sleep(0.01)
+    raise AssertionError(
+        f"store proc did not come up (rc={proc.poll()}): "
+        f"{proc.stdout.read() if proc.stdout else ''}")
+
+
+def _build_job(name: str, queue: str, tpj: int, cpu: str = "1",
+               priority_class: str = ""):
+    from volcano_tpu.models import Job, JobSpec, TaskSpec
+    return Job(
+        name=name, namespace="soak",
+        spec=JobSpec(
+            min_available=tpj, queue=queue,
+            priority_class_name=priority_class,
+            tasks=[TaskSpec(name="task", replicas=tpj, template={
+                "spec": {"containers": [
+                    {"name": "c",
+                     "requests": {"cpu": cpu, "memory": "1Gi"}}]}})]))
+
+
+def run_store_crash_soak(data_dir: str, waves: int = 10,
+                         kill_at_wave=None, jobs_per_wave: int = 2,
+                         tpj: int = 3, n_nodes: int = 4,
+                         fsync: str = "every",
+                         snapshot_every: int = 4096,
+                         wait_s: float = 30.0) -> dict:
+    """Run the soak; ``kill_at_wave=k`` SIGKILLs + restarts the store
+    process after wave k's pods are durable but before the solve that
+    binds them (the worst quiescent point: the whole wave exists ONLY in
+    the store). Returns the decision trace + ride-through evidence."""
+    from helpers import build_node, build_queue
+    from volcano_tpu.cache import FakeEvictor, SchedulerCache
+    from volcano_tpu.client import RemoteClusterStore
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.models import PodGroupPhase
+    from volcano_tpu.scheduler import Scheduler
+
+    port = free_port()
+    proc = start_store_proc(port, data_dir, fsync=fsync,
+                            snapshot_every=snapshot_every)
+    crash_resyncs = []
+    remote = RemoteClusterStore(
+        f"127.0.0.1:{port}", connect_timeout=2.0,
+        retry_attempts=10, retry_base_s=0.1, retry_cap_s=1.0,
+        watch_backoff_cap_s=0.5,
+        on_watch_failure=lambda: crash_resyncs.append(1))
+    result = {
+        "waves": waves, "kill_at_wave": kill_at_wave,
+        "binds_by_wave": [], "crashes": 0, "stalls": [],
+        "restart_s": None,
+    }
+
+    def wait_until(cond, pump=None, timeout=wait_s):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pump is not None:
+                pump()
+            if cond():
+                return True
+            time.sleep(0.02)
+        return cond()
+
+    try:
+        from volcano_tpu.models import PriorityClass
+        remote.apply("queues", build_queue("q0", weight=1))
+        # distinct per-job priorities: cross-job scheduling order is then
+        # forced by the priority plugin instead of hanging off wall-clock
+        # creation-timestamp ties, which the crash/golden comparison must
+        # not depend on
+        for j in range(jobs_per_wave):
+            remote.apply("priorityclasses", PriorityClass(
+                name=f"soak-p{j}", value=1000 - j * 100))
+        for i in range(n_nodes):
+            remote.apply("nodes", build_node(
+                f"n{i}", {"cpu": "32", "memory": "128Gi"}))
+        cache = SchedulerCache(remote)
+        cache.evictor = FakeEvictor()
+        cache.run()
+        cache.wait_for_cache_sync()
+        controllers = ControllerManager(remote, default_queue="q0")
+        controllers.run()
+        sched = Scheduler(cache)
+
+        for w in range(waves):
+            names = [f"w{w}-j{j}" for j in range(jobs_per_wave)]
+            for j, name in enumerate(names):
+                remote.create("jobs", _build_job(
+                    name, "q0", tpj, priority_class=f"soak-p{j}"))
+            # controllers: job -> podgroup (gated Pending)
+            if not wait_until(
+                    lambda: all(remote.try_get("podgroups", n, "soak")
+                                is not None for n in names),
+                    pump=controllers.process_all):
+                result["stalls"].append((w, "podgroup"))
+            # scheduler: enqueue flips the podgroups Inqueue
+            if not wait_until(lambda: all(f"soak/{n_}" in cache.jobs
+                                          for n_ in names)):
+                result["stalls"].append((w, "mirror_pg"))
+            def pg_enqueued(name):
+                pg = remote.try_get("podgroups", name, "soak")
+                return pg is not None and pg.status is not None \
+                    and pg.status.phase != PodGroupPhase.PENDING
+
+            try:
+                cache.process_resync_tasks()
+                sched.run_once()
+            except Exception:
+                result["crashes"] += 1
+            if not wait_until(lambda: all(pg_enqueued(n) for n in names)):
+                result["stalls"].append((w, "inqueue"))
+            # controllers: bulk-create the wave's pods (one frame)
+            if not wait_until(
+                    lambda: sum(len(remote.list(
+                        "pods", namespace="soak", name_glob=f"{n}-*"))
+                        for n in names) == jobs_per_wave * tpj,
+                    pump=controllers.process_all):
+                result["stalls"].append((w, "pods"))
+
+            if kill_at_wave == w:
+                # the whole wave now exists ONLY in the store. Kill -9.
+                t0 = time.time()
+                proc.kill()
+                proc.wait(timeout=10)
+                proc = start_store_proc(port, data_dir, fsync=fsync,
+                                        snapshot_every=snapshot_every)
+                result["restart_s"] = round(time.time() - t0, 2)
+
+            def mirror_has_wave(name):
+                job = cache.jobs.get(f"soak/{name}")
+                return job is not None and len(job.tasks) == tpj
+
+            # scheduler: bind the wave
+            if not wait_until(
+                    lambda: all(mirror_has_wave(n) for n in names)):
+                result["stalls"].append((w, "mirror_pods"))
+            try:
+                cache.process_resync_tasks()
+                sched.run_once()
+            except Exception:
+                result["crashes"] += 1
+            cache.wait_for_effects()
+            if not wait_until(
+                    lambda: all(p.node_name for n in names
+                                for p in remote.list(
+                                    "pods", namespace="soak",
+                                    name_glob=f"{n}-*"))):
+                result["stalls"].append((w, "bind"))
+            wave_binds = sorted(
+                (f"{p.namespace}/{p.name}", p.node_name)
+                for n in names
+                for p in remote.list("pods", namespace="soak",
+                                     name_glob=f"{n}-*"))
+            result["binds_by_wave"].append(wave_binds)
+
+            # retire the wave: each wave then solves on an empty
+            # cluster, making the decision trace independent of watch
+            # arrival ordering in earlier waves — the same state
+            # turnover contract as the chaos_churn bench. The deletes
+            # also push "delete" records through the WAL, so recovery
+            # replays both sides of the object lifecycle. Deleting is
+            # a LOOP over everything left in the namespace, not one
+            # shot: sync_job re-creates a job (and its pods) that is
+            # missing from the store while its JobInfo is still in the
+            # controller cache — which happens exactly when the
+            # job-delete event is lagging on a just-resumed watch
+            # stream — so retire keeps sweeping until the CONTROLLER
+            # cache has seen the deletions too, after which nothing is
+            # left to resurrect.
+            from volcano_tpu.client import NotFoundError
+            from volcano_tpu.controllers import JobController
+
+            jc = next(c for c in controllers.controllers
+                      if isinstance(c, JobController))
+
+            def retire_pump():
+                controllers.process_all()
+                for kind in ("jobs", "pods", "podgroups"):
+                    for obj in remote.list(kind, namespace="soak"):
+                        try:
+                            remote.delete(kind, obj.name, "soak")
+                        except NotFoundError:
+                            pass
+
+            def retired():
+                return (not remote.list("pods", namespace="soak")
+                        and not remote.list("jobs", namespace="soak")
+                        and not any(k.startswith("soak/")
+                                    for k in list(jc.cache.jobs))
+                        and not any(k.startswith("soak/")
+                                    for k in list(cache.jobs)))
+
+            if not wait_until(retired, pump=retire_pump):
+                result["stalls"].append((w, "retire"))
+
+        all_binds = [b for wave in result["binds_by_wave"] for b in wave]
+        result["total_binds"] = len(all_binds)
+        result["dup_binds"] = len(all_binds) - len({p for p, _ in all_binds})
+        result["lost_binds"] = sum(
+            1 for _, node in all_binds if not node)
+        result["watch_resumes"] = remote.watch_resumes
+        result["watch_failed"] = remote.watch_failed
+        result["crash_only_resyncs"] = len(crash_resyncs)
+        return result
+    finally:
+        try:
+            remote.close()
+        except Exception:  # noqa: BLE001
+            pass
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass

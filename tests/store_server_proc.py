@@ -1,0 +1,59 @@
+"""Subprocess entry for the kill-9 store crash tests: ONE durable
+ClusterStore served over TCP, nothing else. The driver SIGKILLs this
+process mid-churn and starts a fresh one on the same port + data dir;
+recovery (snapshot + WAL tail replay, client/durable.py) must hand the
+reconnecting scheduler/controllers the exact store they left.
+
+Usage: python store_server_proc.py --port P --data-dir D
+       [--fsync every|interval|off] [--snapshot-every N] [--faults SPEC]
+
+Prints ``READY <port>`` once serving (the driver waits for it), then
+sleeps until killed. ``--faults`` arms the deterministic injector (e.g.
+``store_crash=at:7,exc:exit`` to die AT the Nth commit seam with the
+record durable but the response never sent). Imports stay store-only —
+no jax, no scheduler — so a restart is fast enough for the client's
+request-retry window to ride out.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--fsync", default="every",
+                    choices=["every", "interval", "off"])
+    ap.add_argument("--snapshot-every", type=int, default=4096)
+    ap.add_argument("--faults", default=None)
+    args = ap.parse_args()
+
+    from volcano_tpu.client import DurableClusterStore, StoreServer
+    from volcano_tpu.resilience import faults
+
+    if args.faults:
+        faults.configure(args.faults)
+
+    store = DurableClusterStore(args.data_dir, fsync=args.fsync,
+                                snapshot_every=args.snapshot_every)
+    server = StoreServer(store, port=args.port).start()
+    print(f"READY {server.port} rv={store._rv} "
+          f"recovered={store.recovered_records}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,8 +12,8 @@ import time
 import pytest
 
 from volcano_tpu.client import (
-    AdmissionError, ClusterStore, ConflictError, NotFoundError,
-    RemoteClusterStore, StoreServer,
+    AdmissionError, ClusterStore, ConflictError, DurableClusterStore,
+    NotFoundError, RemoteClusterStore, StoreServer,
 )
 from volcano_tpu.client.codec import decode, encode
 from volcano_tpu.models import (
@@ -514,6 +514,9 @@ class TestSlowWatcher:
         # the writer only notices the stall when its blocked sendall hits
         # the send timeout; the production 30s exceeds this test's budget
         monkeypatch.setattr(srv, "WATCH_SEND_TIMEOUT_S", 1.0)
+        from volcano_tpu.metrics import metrics
+
+        dropped_before = metrics.store_watch_dropped_total.get()
         store = ClusterStore()
         server = StoreServer(store).start()
         try:
@@ -526,6 +529,14 @@ class TestSlowWatcher:
             # condemns the watcher and its listener unsubscribes (the
             # journal's own per-kind listener stays, by design)
             base = 1  # the journal's listener
+            # wait for the handler to actually subscribe first — flooding
+            # before that point exits the loop vacuously (listeners never
+            # exceeded base) and nothing was ever dropped
+            deadline = time.time() + 10
+            while len(store._listeners["nodes"]) <= base \
+                    and time.time() < deadline:
+                time.sleep(0.005)
+            assert len(store._listeners["nodes"]) == base + 1
             deadline = time.time() + 10
             i = 0
             while len(store._listeners["nodes"]) > base \
@@ -536,9 +547,351 @@ class TestSlowWatcher:
                 time.sleep(0.001)
             assert len(store._listeners["nodes"]) == base, \
                 "slow watcher was never dropped"
+            # the drop is no longer log-only: it is exported
+            deadline = time.time() + 5
+            while metrics.store_watch_dropped_total.get() \
+                    <= dropped_before and time.time() < deadline:
+                time.sleep(0.02)
+            assert metrics.store_watch_dropped_total.get() \
+                > dropped_before
             sock.close()
         finally:
             server.stop()
+
+
+class TestWAL:
+    """WAL edge cases: torn-tail truncation, fsync policies, framing."""
+
+    def _fill(self, d, n=5):
+        store = DurableClusterStore(str(d))
+        for i in range(n):
+            store.create("nodes", build_node(f"n{i}", {"cpu": "1"}))
+        store.close()
+        return store
+
+    def test_torn_final_record_truncated(self, tmp_path):
+        from volcano_tpu.client.durable import read_frames
+        store = self._fill(tmp_path, n=5)
+        seg = [p for p in os.listdir(tmp_path) if p.startswith("wal-")]
+        assert len(seg) == 1
+        path = str(tmp_path / seg[0])
+        good_size = os.path.getsize(path)
+        # a crash mid-append: half a record's worth of debris at the tail
+        with open(path, "ab") as f:
+            f.write(b"\xff\x00\x00\x00garbage-that-is-not-a-frame")
+        records, valid, torn = read_frames(path)
+        assert torn and len(records) == 5 and valid == good_size
+        s2 = DurableClusterStore(str(tmp_path))
+        assert sorted(n.name for n in s2.list("nodes")) \
+            == [f"n{i}" for i in range(5)]
+        assert s2._rv == store._rv  # rv counter restored exactly
+        assert os.path.getsize(path) == good_size  # debris cut off
+        # appends after recovery land on a clean frame boundary
+        s2.create("nodes", build_node("post", {"cpu": "1"}))
+        s2.close()
+        s3 = DurableClusterStore(str(tmp_path))
+        assert s3.try_get("nodes", "post") is not None
+
+    def test_corrupt_crc_truncates_from_there(self, tmp_path):
+        self._fill(tmp_path, n=4)
+        seg = [p for p in os.listdir(tmp_path) if p.startswith("wal-")]
+        path = str(tmp_path / seg[0])
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) - 3] ^= 0xFF  # flip a byte inside the LAST record
+        open(path, "wb").write(raw)
+        s2 = DurableClusterStore(str(tmp_path))
+        # the first three records survive; the corrupted final one is gone
+        assert sorted(n.name for n in s2.list("nodes")) \
+            == ["n0", "n1", "n2"]
+
+    def test_fsync_policies(self, tmp_path):
+        s_every = DurableClusterStore(str(tmp_path / "every"),
+                                      fsync="every")
+        for i in range(4):
+            s_every.create("nodes", build_node(f"n{i}", {"cpu": "1"}))
+        assert s_every.wal.fsyncs == 4  # one per commit
+
+        s_int = DurableClusterStore(str(tmp_path / "interval"),
+                                    fsync="interval",
+                                    fsync_interval_s=3600.0)
+        for i in range(4):
+            s_int.create("nodes", build_node(f"n{i}", {"cpu": "1"}))
+        assert s_int.wal.fsyncs <= 1  # group commit: the window absorbs
+
+        s_off = DurableClusterStore(str(tmp_path / "off"), fsync="off")
+        for i in range(4):
+            s_off.create("nodes", build_node(f"n{i}", {"cpu": "1"}))
+        assert s_off.wal.fsyncs == 0
+        # flushed-but-not-fsynced records still survive a PROCESS death
+        # (the bytes are in the OS): a fresh recovery sees them
+        s2 = DurableClusterStore(str(tmp_path / "off"))
+        assert len(s2.list("nodes")) == 4
+
+    def test_wal_fsync_fault_point_fires(self, tmp_path):
+        from volcano_tpu.resilience import faults
+        faults.reset()
+        try:
+            faults.arm("wal_fsync", every=1, exc=None)
+            store = DurableClusterStore(str(tmp_path), fsync="every")
+            store.create("nodes", build_node("n0", {"cpu": "1"}))
+            assert faults.fired("wal_fsync") >= 1
+        finally:
+            faults.reset()
+
+    def test_store_crash_point_sits_between_append_and_announce(
+            self, tmp_path):
+        from volcano_tpu.resilience import faults
+        faults.reset()
+        try:
+            seen = []
+            store = DurableClusterStore(str(tmp_path))
+            store.watch("nodes", lambda ev, obj, old:
+                        seen.append(obj.name), replay=False)
+            faults.arm_once("store_crash")
+            with pytest.raises(ConnectionError):
+                store.create("nodes", build_node("n0", {"cpu": "1"}))
+            # the record IS durable (the crash seam is after the append)
+            # but no listener ever heard the commit announced
+            assert seen == []
+            assert store.wal.appends == 1
+        finally:
+            faults.reset()
+
+
+class TestDurableRecovery:
+    def test_full_state_roundtrip_with_rv_counters(self, tmp_path):
+        s1 = DurableClusterStore(str(tmp_path))
+        s1.create("queues", build_queue("q1", weight=3))
+        n = s1.create("nodes", build_node("n1", {"cpu": "4"}))
+        n.unschedulable = True
+        s1.update("nodes", n)
+        s1.create("pods", build_pod("ns", "p1", "", "Pending",
+                                    {"cpu": "1"}, "pg"))
+        s1.delete("pods", "p1", "ns")
+        s1.create("podgroups", build_pod_group("pg1", "ns", min_member=2))
+        s2 = DurableClusterStore(str(tmp_path))
+        assert s2._rv == s1._rv
+        assert s2._kind_rv == s1._kind_rv
+        assert s2.get("nodes", "n1").unschedulable is True
+        assert s2.get("nodes", "n1").resource_version \
+            == s1.get("nodes", "n1").resource_version
+        assert s2.list("pods") == []  # the delete replayed too
+        assert s2.get("podgroups", "pg1", "ns").spec.min_member == 2
+        assert s2.recovered_records == 6
+
+    def test_corrupt_snapshot_falls_back_to_previous_plus_wal(
+            self, tmp_path):
+        s1 = DurableClusterStore(str(tmp_path))
+        for i in range(3):
+            s1.create("nodes", build_node(f"a{i}", {"cpu": "1"}))
+        s1.snapshot()
+        for i in range(3):
+            s1.create("nodes", build_node(f"b{i}", {"cpu": "1"}))
+        s1.snapshot()
+        s1.create("nodes", build_node("tail", {"cpu": "1"}))
+        s1.close()
+        snaps = sorted(p for p in os.listdir(tmp_path)
+                       if p.startswith("snapshot-"))
+        assert len(snaps) == 2
+        newest = str(tmp_path / snaps[-1])
+        raw = bytearray(open(newest, "rb").read())
+        raw[20] ^= 0xFF
+        open(newest, "wb").write(raw)
+        s2 = DurableClusterStore(str(tmp_path))
+        assert s2.snapshot_fallbacks == 1
+        assert sorted(n.name for n in s2.list("nodes")) \
+            == sorted(["a0", "a1", "a2", "b0", "b1", "b2", "tail"])
+        assert s2._rv == s1._rv
+
+    def test_snapshot_compaction_prunes_and_recovers(self, tmp_path):
+        s1 = DurableClusterStore(str(tmp_path), snapshot_every=4)
+        for i in range(11):  # crosses the threshold twice
+            s1.create("nodes", build_node(f"n{i}", {"cpu": "1"}))
+        s1.close()
+        snaps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("snapshot-")]
+        assert len(snaps) == 2  # keep_snapshots caps retention
+        s2 = DurableClusterStore(str(tmp_path))
+        assert len(s2.list("nodes")) == 11
+        assert s2._rv == s1._rv
+
+    def test_watch_resumes_across_store_restart(self, tmp_path):
+        """The tentpole seam: a watcher mid-stream when the store dies
+        resumes over the restart via ``since:`` — the events it missed
+        (committed while it was disconnected) replay from the journal
+        seeded out of the recovered WAL tail. No crash-only resync."""
+        s1 = DurableClusterStore(str(tmp_path))
+        server = StoreServer(s1)
+        server.start()
+        port = server.port
+        fired = []
+        remote = RemoteClusterStore(server.address,
+                                    watch_backoff_cap_s=0.3,
+                                    on_watch_failure=lambda:
+                                    fired.append(1))
+        seen = []
+        remote.watch("nodes", lambda ev, obj, old:
+                     seen.append((ev, obj.name)))
+        s1.create("nodes", build_node("n1", {"cpu": "1"}))
+        deadline = time.time() + 5
+        while len(seen) < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert seen == [("add", "n1")]
+        # the server dies; MORE writes commit before the crash finishes
+        # taking the store down (the watcher never hears them live)
+        server.stop()
+        s1.create("nodes", build_node("n2", {"cpu": "1"}))
+        n2 = s1.get("nodes", "n2")
+        n2.unschedulable = True
+        s1.update("nodes", n2)
+        del s1  # crash: no clean close
+        s2 = DurableClusterStore(str(tmp_path))
+        server2 = StoreServer(s2, port=port).start()
+        try:
+            deadline = time.time() + 10
+            while len(seen) < 3 and time.time() < deadline:
+                time.sleep(0.02)
+            assert seen == [("add", "n1"), ("add", "n2"),
+                            ("update", "n2")]
+            assert remote.watch_resumes == 1
+            assert not remote.watch_failed and fired == []
+            # and the stream is LIVE again after the replay
+            s2.create("nodes", build_node("n3", {"cpu": "1"}))
+            deadline = time.time() + 5
+            while len(seen) < 4 and time.time() < deadline:
+                time.sleep(0.02)
+            assert seen[-1] == ("add", "n3")
+        finally:
+            remote.close()
+            server2.stop()
+
+    def test_in_memory_default_untouched(self, tmp_path):
+        """No --store-data-dir => no WAL I/O: the plain store has no
+        journaling seam engaged and writes nothing to disk."""
+        store = ClusterStore()
+        assert not hasattr(store, "_wal")
+        before = set(os.listdir(tmp_path))
+        store.create("nodes", build_node("n1", {"cpu": "1"}))
+        store.bulk_apply([("nodes", build_node("n2", {"cpu": "1"}))])
+        assert set(os.listdir(tmp_path)) == before
+
+
+class TestBulkApply:
+    def test_in_memory_mixed_verbs_and_containment(self):
+        store = ClusterStore()
+
+        def deny(verb, kind, obj):
+            if kind == "pods" and obj.name == "bad":
+                raise AdmissionError("denied")
+            return obj
+
+        store.add_interceptor(deny)
+        store.create("nodes", build_node("n1", {"cpu": "1"}))
+        results = store.bulk_apply([
+            ("pods", build_pod("ns", "p1", "", "Pending",
+                               {"cpu": "1"}, "pg"), "create"),
+            ("pods", build_pod("ns", "bad", "", "Pending",
+                               {"cpu": "1"}, "pg"), "create"),
+            ("pods", build_pod("ns", "p2", "", "Pending",
+                               {"cpu": "1"}, "pg"), "create"),
+            ("nodes", build_node("n1", {"cpu": "2"}), "apply"),
+        ])
+        assert [type(r).__name__ for r in results] \
+            == ["Pod", "AdmissionError", "Pod", "Node"]
+        # the denied pod cost that pod, not the wave
+        assert sorted(p.name for p in store.list("pods")) == ["p1", "p2"]
+        assert store.get("nodes", "n1").allocatable["cpu"] == "2"
+        # duplicate create surfaces per-item too
+        results = store.bulk_apply([
+            ("pods", build_pod("ns", "p1", "", "Pending",
+                               {"cpu": "1"}, "pg"), "create")])
+        assert isinstance(results[0], ConflictError)
+
+    def test_over_the_wire_one_frame(self, served_store):
+        store, remote = served_store
+        results = remote.bulk_apply(
+            [("nodes", build_node(f"n{i}", {"cpu": "1"}))
+             for i in range(10)]
+            + [("pods", build_pod("ns", "p0", "", "Pending",
+                                  {"cpu": "1"}, "pg"), "create")])
+        assert all(not isinstance(r, Exception) for r in results)
+        assert len(store.list("nodes")) == 10
+        # per-item errors come back as rebuilt exception instances
+        results = remote.bulk_apply(
+            [("pods", build_pod("ns", "p0", "", "Pending",
+                                {"cpu": "1"}, "pg"), "create"),
+             ("nodes", build_node("n0", {"cpu": "4"}))])
+        assert isinstance(results[0], ConflictError)
+        assert results[1].allocatable["cpu"] == "4"
+
+    def test_one_journal_batch_one_fsync(self, tmp_path):
+        store = DurableClusterStore(str(tmp_path), fsync="every")
+        base_syncs = store.wal.fsyncs
+        store.bulk_apply([("nodes", build_node(f"n{i}", {"cpu": "1"}))
+                          for i in range(16)])
+        assert store.wal.appends == 16
+        assert store.wal.fsyncs == base_syncs + 1  # ONE sync per batch
+        # and everything in the batch is durable
+        s2 = DurableClusterStore(str(tmp_path))
+        assert len(s2.list("nodes")) == 16
+
+
+class TestJobControllerBulkIngest:
+    def test_wave_created_in_one_batch(self, monkeypatch):
+        from volcano_tpu.controllers import ControllerManager
+        from volcano_tpu.models import Job, JobSpec, PodGroupPhase, TaskSpec
+
+        store = ClusterStore()
+        calls = []
+        orig = ClusterStore.bulk_apply
+
+        def spy(self, items, fencing=None):
+            items = list(items)
+            calls.append(len(items))
+            return orig(self, items, fencing=fencing)
+
+        monkeypatch.setattr(ClusterStore, "bulk_apply", spy)
+        cm = ControllerManager(store)
+        cm.run()
+        store.create("jobs", Job(
+            name="bulkjob", namespace="default",
+            spec=JobSpec(min_available=3, tasks=[TaskSpec(
+                name="task", replicas=3, template={
+                    "spec": {"containers": [{"name": "c", "requests":
+                             {"cpu": "1", "memory": "1Gi"}}]}})])))
+        cm.process_all()
+        pg = store.get("podgroups", "bulkjob", "default")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update("podgroups", pg)
+        cm.process_all()
+        assert sorted(p.name for p in store.list("pods")) \
+            == ["bulkjob-task-0", "bulkjob-task-1", "bulkjob-task-2"]
+        assert 3 in calls  # the whole wave went through ONE batch
+
+
+@pytest.mark.slow
+class TestStoreCrashSoak:
+    def test_kill9_recovery_trace_identical_to_golden(self, tmp_path):
+        """The acceptance bar: SIGKILL the durable store process with a
+        wave's pods committed but unbound, restart it on the same port +
+        data dir, and the scheduler + controllers ride through — decision
+        trace bind-for-bind identical to the uninterrupted golden run,
+        zero lost/dup binds, every watcher resumed via ``since:`` (no
+        crash-only resync)."""
+        from durable_soak import run_store_crash_soak
+
+        golden = run_store_crash_soak(str(tmp_path / "golden"), waves=6)
+        crash = run_store_crash_soak(str(tmp_path / "crash"), waves=6,
+                                     kill_at_wave=3)
+        assert golden["crashes"] == 0 and golden["stalls"] == []
+        assert crash["crashes"] == 0 and crash["stalls"] == []
+        assert crash["restart_s"] is not None
+        assert crash["binds_by_wave"] == golden["binds_by_wave"]
+        assert crash["total_binds"] == 6 * 2 * 3
+        assert crash["dup_binds"] == 0 and crash["lost_binds"] == 0
+        assert crash["watch_resumes"] > 0
+        assert not crash["watch_failed"]
+        assert crash["crash_only_resyncs"] == 0
 
 
 class TestVcctlTLSFlags:

@@ -1,5 +1,7 @@
-"""Cluster store: the API-server/informer seam (in-memory + over TCP)."""
+"""Cluster store: the API-server/informer seam (in-memory + over TCP),
+plus the optional WAL/snapshot durability layer behind it."""
 
+from .durable import DurableClusterStore, WriteAheadLog  # noqa: F401
 from .remote import RemoteClusterStore  # noqa: F401
 from .server import StoreServer  # noqa: F401
 from .store import (  # noqa: F401

@@ -30,7 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..resilience.faultinject import faults
 from .codec import decode, encode
-from .server import MAGIC, raise_remote, recv_frame, send_frame
+from .server import MAGIC, raise_remote, recv_frame, remote_error, send_frame
 from .store import ResumeGapError
 
 log = logging.getLogger(__name__)
@@ -246,6 +246,22 @@ class RemoteClusterStore:
             {"op": "delete", "kind": kind, "name": name,
              "namespace": namespace, "fencing": fencing})["obj"])
 
+    def bulk_apply(self, items, fencing: Optional[dict] = None) -> List[Any]:
+        """Batch mutation in ONE frame each way (the ROADMAP item-3 bulk
+        ingest op): same contract as ClusterStore.bulk_apply — items are
+        (kind, obj[, verb]) and the result list carries the applied
+        object or the rebuilt exception instance per position. Not
+        retried after an unacked send (a bulk wave is not conditional as
+        a unit); a failed SEND retries like every other op."""
+        resp = self._request({
+            "op": "bulk_apply",
+            "items": [{"kind": it[0], "obj": encode(it[1]),
+                       "verb": it[2] if len(it) > 2 else "apply"}
+                      for it in items],
+            "fencing": fencing})
+        return [remote_error(r) if "error" in r else decode(r["obj"])
+                for r in resp["results"]]
+
     def get(self, kind: str, name: str, namespace: Optional[str] = None):
         return decode(self._request(
             {"op": "get", "kind": kind, "name": name,
@@ -310,7 +326,11 @@ class RemoteClusterStore:
                         return
                     cur = self._resume_watch(kind, listener, state)
                     if cur is None:
-                        self._watch_broke(kind, e)
+                        # a resume abandoned because close() landed
+                        # mid-attempt is a clean shutdown, not a broken
+                        # mirror — don't fire the crash-only contract
+                        if not self._closed:
+                            self._watch_broke(kind, e)
                         return
                     continue
                 except Exception as e:  # noqa: BLE001 — a listener blew up

@@ -74,7 +74,15 @@ class EventJournal:
     write, no JSON. With the store's in-place-update idiom a replayed
     event can therefore carry a slightly newer object state than it
     committed with; the mirror still converges (level-triggered, and the
-    cache's handlers are resync-safe)."""
+    cache's handlers are resync-safe).
+
+    A DurableClusterStore that just recovered exposes the WAL-tail
+    events it replayed (``recovery_tail``/``recovery_floors``,
+    client/durable.py); they seed this journal's window, so a watcher
+    that was mid-stream when the store crashed resumes through the same
+    ``since:`` path over the restart — the events it missed while the
+    store was down are replayed from disk instead of forcing the
+    crash-only full resync."""
 
     def __init__(self, store: ClusterStore, capacity: int = JOURNAL_CAPACITY):
         self.store = store
@@ -84,10 +92,20 @@ class EventJournal:
         #: per kind: events at or below this rv are NOT replayable
         self._floor: Dict[str, int] = {}
         self._listeners = []
+        seed = getattr(store, "recovery_tail", None) or {}
+        floors = getattr(store, "recovery_floors", None) or {}
         with store.locked():
             for kind in KINDS:
                 self._events[kind] = collections.deque()
                 self._floor[kind] = store.last_event_rv(kind)
+                tail = seed.get(kind)
+                if tail:
+                    self._floor[kind] = int(floors.get(kind, 0))
+                    q = self._events[kind]
+                    for entry in tail:
+                        if len(q) >= self.capacity:
+                            self._floor[kind] = q.popleft()[0]
+                        q.append(entry)
                 listener = self._make_listener(kind)
                 self._listeners.append((kind, listener))
                 store.watch(kind, listener, replay=False)
@@ -144,10 +162,16 @@ def recv_frame(sock: socket.socket) -> dict:
     return json.loads(recv_exact(sock, length))
 
 
+def remote_error(resp: dict) -> Exception:
+    """Rebuild a {"ok": false} response (or a bulk_apply per-item error
+    entry) as its original exception class, without raising."""
+    cls = _ERRORS.get(resp.get("error"), RuntimeError)
+    return cls(resp.get("message", "remote store error"))
+
+
 def raise_remote(resp: dict) -> None:
     """Re-raise a {"ok": false} response as its original error class."""
-    cls = _ERRORS.get(resp.get("error"), RuntimeError)
-    raise cls(resp.get("message", "remote store error"))
+    raise remote_error(resp)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -234,6 +258,20 @@ class _Handler(socketserver.BaseRequestHandler):
             obj = store.delete(kind, req["name"], req.get("namespace"),
                                fencing=fencing)
             return {"ok": True, "obj": encode(obj)}
+        if op == "bulk_apply":
+            # one frame, many objects, one journal batch (the durable
+            # store fsyncs once for the wave); per-item results so one
+            # rejected object costs that object, not the wave
+            items = [(it["kind"], decode(it["obj"]),
+                      it.get("verb", "apply")) for it in req["items"]]
+            out = []
+            for res in store.bulk_apply(items, fencing=fencing):
+                if isinstance(res, Exception):
+                    out.append({"error": type(res).__name__,
+                                "message": str(res)})
+                else:
+                    out.append({"obj": encode(res)})
+            return {"ok": True, "results": out}
         if op == "get":
             obj = store.get(kind, req["name"], req.get("namespace"))
             return {"ok": True, "obj": encode(obj)}
@@ -344,6 +382,21 @@ class _Handler(socketserver.BaseRequestHandler):
                 send_frame(sock, payload)
             log.warning("watch stream overflowed %d events; dropping the "
                         "slow watcher", WATCH_QUEUE_MAX)
+            try:
+                from ..metrics import metrics
+                metrics.store_watch_dropped_total.inc()
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+        except socket.timeout:
+            # the other slow-watcher shape: a peer that stalls without
+            # closing (TCP zero window) blocks sendall past the timeout
+            log.warning("watch send stalled > %.0fs; dropping the slow "
+                        "watcher", WATCH_SEND_TIMEOUT_S)
+            try:
+                from ..metrics import metrics
+                metrics.store_watch_dropped_total.inc()
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
         except (ConnectionError, OSError, ValueError):
             pass  # peer went away
         finally:

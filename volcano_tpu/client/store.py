@@ -243,6 +243,53 @@ class ClusterStore:
             self._notify(kind, "delete", obj)
             return obj
 
+    def bulk_apply(self, items, fencing: Optional[dict] = None) -> List[Any]:
+        """Batch mutation: many objects under ONE lock hold (and, on the
+        durable store, one journal batch — a single fsync covers the
+        whole wave). ``items`` is an iterable of ``(kind, obj)`` or
+        ``(kind, obj, verb)`` with verb in {"apply", "create",
+        "update"}; default "apply".
+
+        Per-item containment, not a transaction: each object commits (or
+        fails) independently, in order, and the result list carries the
+        applied object OR the exception instance at that item's position
+        — a rejected pod in a 500-pod ingest wave costs that pod, not
+        the wave. The wire op (StoreServer ``bulk_apply``) carries the
+        same contract in one frame each way."""
+        results: List[Any] = []
+        with self._lock:
+            self._batch_begin()
+            try:
+                for item in items:
+                    kind, obj = item[0], item[1]
+                    verb = item[2] if len(item) > 2 else "apply"
+                    try:
+                        if verb == "create":
+                            results.append(self.create(kind, obj,
+                                                       fencing=fencing))
+                        elif verb == "update":
+                            results.append(self.update(kind, obj,
+                                                       fencing=fencing))
+                        elif verb == "apply":
+                            results.append(self.apply(kind, obj,
+                                                      fencing=fencing))
+                        else:
+                            raise ValueError(
+                                f"bulk_apply verb {verb!r} not in "
+                                "('apply', 'create', 'update')")
+                    except Exception as e:  # noqa: BLE001 — per-item result
+                        results.append(e)
+            finally:
+                self._batch_end()
+        return results
+
+    def _batch_begin(self) -> None:
+        """Journal-batch seam (no-op in memory; the durable store defers
+        fsync until _batch_end so a bulk write costs one sync)."""
+
+    def _batch_end(self) -> None:
+        pass
+
     def get(self, kind: str, name: str, namespace: Optional[str] = None):
         with self._lock:
             key = f"{namespace}/{name}" if namespace is not None else name
@@ -310,6 +357,9 @@ class FencedStore:
     def delete(self, kind: str, name: str, namespace: Optional[str] = None):
         return self._store.delete(kind, name, namespace,
                                   fencing=self._token())
+
+    def bulk_apply(self, items):
+        return self._store.bulk_apply(items, fencing=self._token())
 
     def __getattr__(self, name):
         # reads (get/try_get/list/watch/locked/...) forward unfenced

@@ -455,18 +455,25 @@ class JobController(Controller):
                     f"{job.name}-{task.name}-{i}"] = (task, i)
 
         # create missing, delete surplus (scale down)
+        to_create = []
         for task_name, pods in desired.items():
             actual = ji.pods.get(task_name, {})
             for pod_name, (task, i) in pods.items():
                 if pod_name not in actual and create_allowed:
-                    pod = self._create_job_pod(job, task, i)
-                    try:
-                        self.cluster.create("pods", pod)
-                    except AdmissionError as e:
-                        log.info("pod %s rejected by admission: %s",
-                                 pod.name, e)
-                    except Exception:
-                        log.exception("failed to create pod %s", pod.name)
+                    to_create.append(self._create_job_pod(job, task, i))
+        if to_create:
+            # one frame / one journal batch for the whole wave (the
+            # ROADMAP item-3 bulk ingest seam); per-item results keep
+            # the old loop's containment — a rejected pod costs that
+            # pod, not the wave
+            for pod, res in zip(to_create, self.cluster.bulk_apply(
+                    [("pods", pod, "create") for pod in to_create])):
+                if isinstance(res, AdmissionError):
+                    log.info("pod %s rejected by admission: %s",
+                             pod.name, res)
+                elif isinstance(res, Exception):
+                    log.error("failed to create pod %s: %s",
+                              pod.name, res)
         for task_name, actual in list(ji.pods.items()):
             wanted = desired.get(task_name, {})
             for pod_name, pod in list(actual.items()):

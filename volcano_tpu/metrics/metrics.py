@@ -328,6 +328,45 @@ job_retry_total = registry.register(Counter(
     "Job controller re-enqueues after a failed sync (capped exponential "
     "backoff per job key)", ["job_id"]))
 
+# -- durable store metrics (client/durable.py + client/server.py) -----------
+
+store_watch_dropped_total = registry.register(Counter(
+    "volcano_store_watch_dropped_total",
+    "Slow watchers dropped by the store server (event queue overflow or "
+    "send stall past the timeout); the client resumes via its rv "
+    "high-water mark"))
+store_wal_appends_total = registry.register(Counter(
+    "volcano_store_wal_appends_total",
+    "Mutation records appended to the store write-ahead log"))
+store_wal_append_seconds = registry.register(Histogram(
+    "volcano_store_wal_append_seconds",
+    "Latency of one WAL append (encode + write + policy fsync)"))
+store_wal_fsyncs_total = registry.register(Counter(
+    "volcano_store_wal_fsyncs_total",
+    "WAL fsyncs (every commit under fsync=every, one per bulk_apply "
+    "batch, at most one per interval under fsync=interval)"))
+store_wal_size_bytes = registry.register(Gauge(
+    "volcano_store_wal_size_bytes",
+    "Bytes in the active WAL segment (resets at every snapshot "
+    "rotation)"))
+store_wal_snapshots_total = registry.register(Counter(
+    "volcano_store_wal_snapshots_total",
+    "Store snapshots written (WAL compactions)"))
+store_wal_snapshot_bytes = registry.register(Gauge(
+    "volcano_store_wal_snapshot_bytes",
+    "Size of the newest store snapshot"))
+store_wal_snapshot_timestamp = registry.register(Gauge(
+    "volcano_store_wal_snapshot_timestamp_seconds",
+    "Unix time the newest store snapshot was written (snapshot age = "
+    "now - this)"))
+store_wal_recovery_ms = registry.register(Gauge(
+    "volcano_store_wal_recovery_milliseconds",
+    "Wall time of the last store recovery (snapshot load + WAL tail "
+    "replay)"))
+store_wal_recovery_records = registry.register(Gauge(
+    "volcano_store_wal_recovery_records",
+    "WAL records replayed on top of the snapshot by the last recovery"))
+
 # -- global rescheduler metrics (reschedule/) -------------------------------
 
 reschedule_plans_total = registry.register(Counter(

@@ -45,7 +45,14 @@ failure and skips the pass), and ``migration_commit``
 (reschedule/action.py, per migration wave, after the wave's
 migration-intent write and before its evictions dispatch — ``at:1``
 crashes with the first wave journaled but zero evictions applied,
-``at:2`` with wave one fully evicted and wave two only journaled).
+``at:2`` with wave one fully evicted and wave two only journaled),
+``wal_fsync`` (client/durable.py WriteAheadLog.sync — arm ``delay:`` for
+a slow disk, ``exc:`` for an fsync failure surfacing to the writer), and
+``store_crash`` (DurableClusterStore commit seam, after the WAL append
+and before the commit is announced to listeners/clients — arm
+``exc:exit`` to kill the store process with the record durable but the
+response never sent, the ambiguous crash the conditional-retry rules in
+client/remote.py exist for).
 """
 
 from __future__ import annotations

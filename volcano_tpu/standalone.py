@@ -58,7 +58,11 @@ class Standalone:
                  reschedule_interval: int = 0,
                  reschedule_max_moves: Optional[int] = None,
                  reschedule_max_disruption: Optional[int] = None,
-                 reschedule_min_improvement: Optional[float] = None):
+                 reschedule_min_improvement: Optional[float] = None,
+                 store_data_dir: Optional[str] = None,
+                 store_fsync: str = "every",
+                 store_fsync_interval_s: float = 0.05,
+                 store_snapshot_every: int = 4096):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -66,10 +70,22 @@ class Standalone:
         from .scheduler import Scheduler
         from .webhooks import start_webhooks
 
-        self.store = ClusterStore()
+        if store_data_dir:
+            # durable control plane: WAL + snapshots under the data dir,
+            # recovery (snapshot load + WAL replay) happens right here in
+            # the constructor — jobs, leases and both intent journals
+            # survive a store crash. The in-memory default stays untouched.
+            from .client import DurableClusterStore
+            self.store = DurableClusterStore(
+                store_data_dir, fsync=store_fsync,
+                fsync_interval_s=store_fsync_interval_s,
+                snapshot_every=store_snapshot_every)
+        else:
+            self.store = ClusterStore()
         # admission interceptors must be installed BEFORE the store starts
         # accepting remote writes, or an early vcctl create slips past the
-        # webhook chain
+        # webhook chain (recovery above bypasses admission by design: the
+        # recovered objects were admitted when they first committed)
         start_webhooks(self.store, scheduler_name=scheduler_name,
                        default_queue=default_queue)
         self.store_server = None
@@ -173,13 +189,12 @@ class Standalone:
             # and the header's node pool is materialized so the trace is
             # actually runnable — in standalone the ClusterStore IS the
             # cluster, there are no real kubelets to register nodes
-            for q in wl.queue_objects():
-                self.store.apply("queues", q)
-            for pc in wl.priority_class_objects():
-                self.store.apply("priorityclasses", pc)
-            for node in wl.node_objects():
-                if self.store.try_get("nodes", node.name) is None:
-                    self.store.create("nodes", node)
+            self.store.bulk_apply(
+                [("queues", q) for q in wl.queue_objects()]
+                + [("priorityclasses", pc)
+                   for pc in wl.priority_class_objects()]
+                + [("nodes", node) for node in wl.node_objects()
+                   if self.store.try_get("nodes", node.name) is None])
         if sidecar_path:
             from .parallel.sidecar import SidecarSolver
             self.cache.sidecar = SidecarSolver(sidecar_path)
@@ -279,6 +294,9 @@ class Standalone:
             self.store_server.stop()
         if self.webhook_server is not None:
             self.webhook_server.shutdown()
+        close = getattr(self.store, "close", None)
+        if close is not None:
+            close()  # flush + fsync the WAL (recovery never depends on it)
 
     def apply_job_yaml(self, text: str) -> None:
         import yaml
@@ -310,6 +328,31 @@ def main(argv=None) -> int:
                          "--server and remote components can drive this "
                          "control plane; non-loopback binds require "
                          "VOLCANO_STORE_TOKEN (shared-secret auth)")
+    ap.add_argument("--store-data-dir", metavar="DIR",
+                    help="make the cluster store DURABLE: every committed "
+                         "mutation appends one fsync'd record to a "
+                         "write-ahead log under DIR, compacted into "
+                         "snapshots; on start the store recovers (newest "
+                         "valid snapshot + WAL tail replay) so jobs, "
+                         "leases and the bind/migration intent journals "
+                         "survive a store crash. Default: in-memory, "
+                         "nothing touches disk")
+    ap.add_argument("--store-fsync", default="every",
+                    choices=["every", "interval", "off"],
+                    help="WAL durability: 'every' fsyncs each commit "
+                         "(acked => durable), 'interval' group-commits "
+                         "(at most one fsync per --store-fsync-interval; "
+                         "a crash can lose the last interval), 'off' "
+                         "never fsyncs (survives process kill, not "
+                         "power loss)")
+    ap.add_argument("--store-fsync-interval", type=float, default=0.05,
+                    metavar="SECS",
+                    help="group-commit window for --store-fsync interval")
+    ap.add_argument("--store-snapshot-every", type=int, default=4096,
+                    metavar="N",
+                    help="WAL records between snapshot compactions "
+                         "(bounds both recovery replay length and "
+                         "on-disk log growth)")
     ap.add_argument("--scheduler-name", default="volcano",
                     help="only schedule pods/jobs naming this scheduler "
                          "(options.go: --scheduler-name)")
@@ -429,7 +472,11 @@ def main(argv=None) -> int:
                     reschedule_interval=args.reschedule_interval,
                     reschedule_max_moves=args.reschedule_max_moves,
                     reschedule_max_disruption=args.reschedule_max_disruption,
-                    reschedule_min_improvement=args.reschedule_min_improvement)
+                    reschedule_min_improvement=args.reschedule_min_improvement,
+                    store_data_dir=args.store_data_dir,
+                    store_fsync=args.store_fsync,
+                    store_fsync_interval_s=args.store_fsync_interval,
+                    store_snapshot_every=args.store_snapshot_every)
     if args.jobs_dir:
         import glob
         import os
