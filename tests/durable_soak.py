@@ -41,12 +41,14 @@ def free_port() -> int:
 
 def start_store_proc(port: int, data_dir: str, fsync: str = "every",
                      snapshot_every: int = 4096,
-                     timeout: float = 60.0) -> subprocess.Popen:
+                     timeout: float = 60.0,
+                     shards: int = 1) -> subprocess.Popen:
     """Launch store_server_proc.py and wait for its READY line."""
     proc = subprocess.Popen(
         [sys.executable, os.path.join(TESTS_DIR, "store_server_proc.py"),
          "--port", str(port), "--data-dir", data_dir,
-         "--fsync", fsync, "--snapshot-every", str(snapshot_every)],
+         "--fsync", fsync, "--snapshot-every", str(snapshot_every),
+         "--shards", str(shards)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=os.path.dirname(TESTS_DIR))
     deadline = time.time() + timeout
@@ -81,11 +83,16 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
                          tpj: int = 3, n_nodes: int = 4,
                          fsync: str = "every",
                          snapshot_every: int = 4096,
-                         wait_s: float = 30.0) -> dict:
+                         wait_s: float = 30.0,
+                         shards: int = 1,
+                         bulk_watch: bool = False) -> dict:
     """Run the soak; ``kill_at_wave=k`` SIGKILLs + restarts the store
     process after wave k's pods are durable but before the solve that
     binds them (the worst quiescent point: the whole wave exists ONLY in
-    the store). Returns the decision trace + ride-through evidence."""
+    the store). Returns the decision trace + ride-through evidence.
+    ``shards`` > 1 runs the store process as a ShardRouter over N
+    per-shard WAL lineages (the kill must then heal every shard);
+    ``bulk_watch`` subscribes the controllers over one batched stream."""
     from helpers import build_node, build_queue
     from volcano_tpu.cache import FakeEvictor, SchedulerCache
     from volcano_tpu.client import RemoteClusterStore
@@ -95,7 +102,7 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
 
     port = free_port()
     proc = start_store_proc(port, data_dir, fsync=fsync,
-                            snapshot_every=snapshot_every)
+                            snapshot_every=snapshot_every, shards=shards)
     crash_resyncs = []
     remote = RemoteClusterStore(
         f"127.0.0.1:{port}", connect_timeout=2.0,
@@ -135,7 +142,8 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
         cache.evictor = FakeEvictor()
         cache.run()
         cache.wait_for_cache_sync()
-        controllers = ControllerManager(remote, default_queue="q0")
+        controllers = ControllerManager(remote, default_queue="q0",
+                                        bulk_watch=bulk_watch)
         controllers.run()
         sched = Scheduler(cache)
 
@@ -180,7 +188,8 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
                 proc.kill()
                 proc.wait(timeout=10)
                 proc = start_store_proc(port, data_dir, fsync=fsync,
-                                        snapshot_every=snapshot_every)
+                                        snapshot_every=snapshot_every,
+                                        shards=shards)
                 result["restart_s"] = round(time.time() - t0, 2)
 
             def mirror_has_wave(name):

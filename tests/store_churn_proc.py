@@ -1,0 +1,65 @@
+"""Writer subprocess for the ``store_shard_scale`` bench: connects to a
+store endpoint and pushes chunked bulk pod waves in ack mode — each wave
+is one bulk create of ``--wave-size`` pods followed (unless
+``--no-update``) by one bulk phase update, so a wave emits 2x wave-size
+events. Separate PROCESSES are the point: client-side encode must not
+share the driver's (or the server's) GIL, or the rig measures Python's
+interpreter lock instead of the store's front door.
+
+Prints ``READY``, waits for ``GO`` on stdin (so process startup never
+pollutes the timed window), then prints ``DONE <events> <seconds>``.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--writer", type=int, default=0)
+    ap.add_argument("--waves", type=int, default=5)
+    ap.add_argument("--wave-size", type=int, default=1250)
+    ap.add_argument("--namespace", default="churn")
+    ap.add_argument("--no-update", action="store_true")
+    args = ap.parse_args()
+
+    from volcano_tpu.client import RemoteClusterStore
+    from volcano_tpu.models import Pod
+
+    client = RemoteClusterStore(args.addr, connect_timeout=5.0)
+    client.ping()
+    print("READY", flush=True)
+    if sys.stdin.readline().strip() != "GO":
+        return 1
+
+    events = 0
+    t0 = time.perf_counter()
+    for v in range(args.waves):
+        pods = [Pod(name=f"w{args.writer}-v{v}-{i}",
+                    namespace=args.namespace, phase="Pending",
+                    scheduler_name="churn-rig",
+                    containers=[{"requests": {"cpu": "1"}}])
+                for i in range(args.wave_size)]
+        res = client.bulk_apply([("pods", p, "create") for p in pods],
+                                ack=True)
+        events += sum(1 for r in res if r is None)
+        if not args.no_update:
+            for p in pods:
+                p.phase = "Running"
+            res = client.bulk_apply([("pods", p, "update") for p in pods],
+                                    ack=True)
+            events += sum(1 for r in res if r is None)
+    dt = time.perf_counter() - t0
+    client.close()
+    print(f"DONE {events} {dt:.3f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,11 +27,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
-    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--data-dir", default="",
+                    help="WAL/snapshot dir; empty = in-memory (the "
+                         "bench's pure front-door throughput rig)")
     ap.add_argument("--fsync", default="every",
                     choices=["every", "interval", "off"])
     ap.add_argument("--snapshot-every", type=int, default=4096)
     ap.add_argument("--faults", default=None)
+    ap.add_argument("--shards", type=int, default=1,
+                    help="serve a sharded store (per-shard WAL lineages "
+                         "under data-dir/shard-NNN) through a "
+                         "ShardRouter on the same wire protocol")
     args = ap.parse_args()
 
     from volcano_tpu.client import DurableClusterStore, StoreServer
@@ -40,11 +46,24 @@ def main() -> int:
     if args.faults:
         faults.configure(args.faults)
 
-    store = DurableClusterStore(args.data_dir, fsync=args.fsync,
-                                snapshot_every=args.snapshot_every)
-    server = StoreServer(store, port=args.port).start()
+    if args.shards > 1:
+        from volcano_tpu.client import ShardedClusterStore, ShardRouter
+        store = ShardedClusterStore(args.shards,
+                                    data_dir=args.data_dir or None,
+                                    fsync=args.fsync,
+                                    snapshot_every=args.snapshot_every)
+        server = ShardRouter(store, port=args.port).start()
+    elif args.data_dir:
+        store = DurableClusterStore(args.data_dir, fsync=args.fsync,
+                                    snapshot_every=args.snapshot_every)
+        server = StoreServer(store, port=args.port).start()
+    else:
+        from volcano_tpu.client import ClusterStore
+        store = ClusterStore()
+        server = StoreServer(store, port=args.port).start()
     print(f"READY {server.port} rv={store._rv} "
-          f"recovered={store.recovered_records}", flush=True)
+          f"recovered={getattr(store, 'recovered_records', 0)}",
+          flush=True)
     try:
         while True:
             time.sleep(3600)

@@ -1,10 +1,14 @@
 """Cluster store: the API-server/informer seam (in-memory + over TCP),
-plus the optional WAL/snapshot durability layer behind it."""
+plus the optional WAL/snapshot durability layer behind it and the
+sharded front door (partitioned store + one-endpoint router)."""
 
 from .durable import DurableClusterStore, WriteAheadLog  # noqa: F401
 from .remote import RemoteClusterStore  # noqa: F401
 from .server import StoreServer  # noqa: F401
+from .sharded import (  # noqa: F401
+    ShardedClusterStore, ShardRouter, shard_for,
+)
 from .store import (  # noqa: F401
     AdmissionError, ClusterStore, ConflictError, FencedError, FencedStore,
-    NotFoundError, ResumeGapError,
+    NotFoundError, ResumeGapError, ShardUnavailableError,
 )

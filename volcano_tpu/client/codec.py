@@ -10,6 +10,22 @@ sides of the wire. JSON (not pickle) keeps the protocol inspectable and
 closed over the model registry: a hostile peer can only instantiate
 volcano_tpu.models classes. Reference parity: the k8s API server speaks
 typed JSON for the same objects (vcctl.go talks to it via client-go).
+
+Hot path: the sharded front door moves tens of thousands of objects per
+second through encode/decode (bulk ingest waves in, watch events out),
+so the codec is built for throughput:
+
+- per-class field plans are cached (``dataclasses.fields`` walks and
+  per-call ``is_dataclass`` probes are paid once per class, not per
+  object);
+- encoding is SPARSE: a field whose value equals its static default (or
+  an empty container from its default factory) is omitted — ``decode``
+  has always rebuilt instances with ``cls(**present_fields)``, so
+  missing fields regain their defaults on the other side, the wire/WAL
+  stays format-compatible in both directions, and a mostly-default Pod
+  costs less than half the bytes (and correspondingly less json time);
+- primitives fast-path on exact type, so enums (str/int subclasses)
+  still route to their tagged form first.
 """
 
 from __future__ import annotations
@@ -17,7 +33,7 @@ from __future__ import annotations
 import base64
 import dataclasses
 import enum
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import models as _models
 
@@ -41,43 +57,102 @@ def _registry() -> Dict[str, type]:
 
 _REGISTRY = _registry()
 
+_MISSING = object()
+
+#: per dataclass: ((field_name, skip_sentinel), ...) — skip_sentinel is
+#: the value to omit from the wire (the field's static default, or the
+#: empty container its default factory produces), or _MISSING when the
+#: field must always be encoded
+_ENC_PLANS: Dict[type, Tuple[Tuple[str, Any], ...]] = {}
+#: per dataclass: frozenset of constructable field names
+_KNOWN: Dict[type, frozenset] = {}
+
+
+def _enc_plan(cls: type) -> Tuple[Tuple[str, Any], ...]:
+    plan = _ENC_PLANS.get(cls)
+    if plan is None:
+        rows: List[Tuple[str, Any]] = []
+        for f in dataclasses.fields(cls):
+            sentinel: Any = _MISSING
+            if f.default is not dataclasses.MISSING:
+                sentinel = f.default
+            elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+                produced = f.default_factory()  # type: ignore[misc]
+                # only stable, empty containers are skippable — a
+                # factory like new_uid() produces a fresh value every
+                # call, which an omitted field would silently replace
+                if produced == {} or produced == [] or produced == ():
+                    sentinel = produced
+            rows.append((f.name, sentinel))
+        plan = _ENC_PLANS[cls] = tuple(rows)
+    return plan
+
+
+def _known(cls: type) -> frozenset:
+    known = _KNOWN.get(cls)
+    if known is None:
+        known = _KNOWN[cls] = frozenset(
+            f.name for f in dataclasses.fields(cls))
+    return known
+
 
 def encode(obj: Any) -> Any:
-    """Model object -> JSON-able structure."""
-    # str/int-enums would pass the primitive isinstance test: tag first
-    if isinstance(obj, enum.Enum):
-        return {_E: type(obj).__name__, "v": obj.value}
-    if obj is None or isinstance(obj, (int, float, str, bool)):
+    """Model object -> JSON-able structure (sparse: default-valued
+    fields are omitted; decode restores them)."""
+    t = obj.__class__
+    # exact-type fast path: a str-enum's class is the enum, not str, so
+    # enums fall through to their tagged form below
+    if obj is None or t is str or t is int or t is float or t is bool:
         return obj
-    if isinstance(obj, bytes):
-        return {_B: base64.b64encode(obj).decode()}
-    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return {_T: type(obj).__name__,
-                "f": {f.name: encode(getattr(obj, f.name))
-                      for f in dataclasses.fields(obj)}}
-    if isinstance(obj, dict):
+    if t is dict:
         out = {k: encode(v) for k, v in obj.items()}
         if _RESERVED & out.keys():
             # a user dict (annotation/label/template) whose own keys
             # collide with a tag must not be mistaken for a tagged node
             return {_D: out}
         return out
-    if isinstance(obj, (list, tuple)):
+    if t is list or t is tuple:
         return [encode(v) for v in obj]
-    raise TypeError(f"cannot encode {type(obj).__name__} for the wire")
+    plan = _ENC_PLANS.get(t)
+    if plan is None:
+        if isinstance(obj, enum.Enum):
+            return {_E: t.__name__, "v": obj.value}
+        if isinstance(obj, bytes):
+            return {_B: base64.b64encode(obj).decode()}
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            plan = _enc_plan(t)
+        elif isinstance(obj, (int, float, str, bool)):
+            return obj  # bool/int/str subclasses that are not enums
+        elif isinstance(obj, dict):
+            out = {k: encode(v) for k, v in obj.items()}
+            return {_D: out} if _RESERVED & out.keys() else out
+        elif isinstance(obj, (list, tuple)):
+            return [encode(v) for v in obj]
+        else:
+            raise TypeError(
+                f"cannot encode {t.__name__} for the wire")
+    fields: Dict[str, Any] = {}
+    for name, sentinel in plan:
+        v = getattr(obj, name)
+        if sentinel is not _MISSING and (
+                v is sentinel or v == sentinel):
+            continue
+        fields[name] = encode(v)
+    return {_T: t.__name__, "f": fields}
 
 
 def decode(data: Any) -> Any:
-    """JSON structure -> model object (closed over the models registry)."""
+    """JSON structure -> model object (closed over the models registry).
+    Fields absent from the wire regain their class defaults."""
     if isinstance(data, dict):
         tag = data.get(_T)
         if tag is not None:
             cls = _REGISTRY.get(tag)
             if cls is None or not dataclasses.is_dataclass(cls):
                 raise ValueError(f"unknown model class {tag!r}")
-            fields = {k: decode(v) for k, v in data["f"].items()}
-            known = {f.name for f in dataclasses.fields(cls)}
-            return cls(**{k: v for k, v in fields.items() if k in known})
+            known = _known(cls)
+            return cls(**{k: decode(v) for k, v in data["f"].items()
+                          if k in known})
         etag = data.get(_E)
         if etag is not None:
             cls = _REGISTRY.get(etag)

@@ -118,13 +118,18 @@ class WriteAheadLog:
     exactly like the mutations they record."""
 
     def __init__(self, path: str, fsync: str = "every",
-                 fsync_interval_s: float = 0.05):
+                 fsync_interval_s: float = 0.05,
+                 metric_labels: Optional[Dict[str, str]] = None):
         if fsync not in FSYNC_POLICIES:
             raise ValueError(f"fsync policy {fsync!r} not in "
                              f"{FSYNC_POLICIES}")
         self.path = path
         self.fsync_policy = fsync
         self.fsync_interval_s = float(fsync_interval_s)
+        # e.g. {"shard": "3"} on a sharded member's WAL, so the
+        # volcano_store_wal_* family separates per shard lineage; the
+        # unsharded store stays label-free (byte-identical exposition)
+        self.metric_labels = metric_labels
         self._f = open(path, "ab")
         self.size_bytes = self._f.tell()
         self.appends = 0
@@ -158,7 +163,7 @@ class WriteAheadLog:
         self.fsyncs += 1
         try:
             from ..metrics import metrics
-            metrics.store_wal_fsyncs_total.inc()
+            metrics.store_wal_fsyncs_total.inc(labels=self.metric_labels)
         except Exception:  # noqa: BLE001 — accounting never fails a write
             pass
 
@@ -184,7 +189,8 @@ def _start_rv(path: str) -> int:
     return int(base.split("-", 1)[1].split(".", 1)[0])
 
 
-def write_snapshot(data_dir: str, state: dict) -> str:
+def write_snapshot(data_dir: str, state: dict,
+                   metric_labels: Optional[Dict[str, str]] = None) -> str:
     """Atomically persist one snapshot blob: tmp file, fsync, rename,
     fsync the directory — a crash at any point leaves either the old
     snapshot set or the old set plus one complete new snapshot."""
@@ -200,9 +206,11 @@ def write_snapshot(data_dir: str, state: dict) -> str:
     _fsync_dir(data_dir)
     try:
         from ..metrics import metrics
-        metrics.store_wal_snapshots_total.inc()
-        metrics.store_wal_snapshot_bytes.set(os.path.getsize(path))
-        metrics.store_wal_snapshot_timestamp.set(time.time())
+        metrics.store_wal_snapshots_total.inc(labels=metric_labels)
+        metrics.store_wal_snapshot_bytes.set(os.path.getsize(path),
+                                             labels=metric_labels)
+        metrics.store_wal_snapshot_timestamp.set(time.time(),
+                                                 labels=metric_labels)
     except Exception:  # noqa: BLE001
         pass
     return path
@@ -237,9 +245,16 @@ class DurableClusterStore(ClusterStore):
                  fsync_interval_s: float = 0.05,
                  snapshot_every: int = SNAPSHOT_EVERY_RECORDS,
                  keep_snapshots: int = KEEP_SNAPSHOTS,
-                 tail_capacity: int = TAIL_CAPACITY):
+                 tail_capacity: int = TAIL_CAPACITY,
+                 shard: Optional[str] = None):
         super().__init__()
         self.data_dir = data_dir
+        # shard name of a sharded member (client/sharded.py): labels the
+        # volcano_store_wal_* metric family so per-shard WAL lineages
+        # separate; None (the unsharded store) keeps the exposition
+        # byte-identical to before
+        self.shard = shard
+        self.metric_labels = {"shard": shard} if shard is not None else None
         self.fsync_policy = fsync
         self.fsync_interval_s = fsync_interval_s
         self.snapshot_every = int(snapshot_every)
@@ -265,8 +280,10 @@ class DurableClusterStore(ClusterStore):
         self._wal = self._open_segment()
         try:
             from ..metrics import metrics
-            metrics.store_wal_recovery_ms.set(self.recovery_ms)
-            metrics.store_wal_recovery_records.set(self.recovered_records)
+            metrics.store_wal_recovery_ms.set(self.recovery_ms,
+                                              labels=self.metric_labels)
+            metrics.store_wal_recovery_records.set(
+                self.recovered_records, labels=self.metric_labels)
         except Exception:  # noqa: BLE001
             pass
 
@@ -400,10 +417,12 @@ class DurableClusterStore(ClusterStore):
             self._wal.append(rec, sync=self._batch_depth == 0)
             try:
                 from ..metrics import metrics
-                metrics.store_wal_appends_total.inc()
+                metrics.store_wal_appends_total.inc(
+                    labels=self.metric_labels)
                 metrics.store_wal_append_seconds.observe(
-                    time.perf_counter() - t0)
-                metrics.store_wal_size_bytes.set(self._wal.size_bytes)
+                    time.perf_counter() - t0, labels=self.metric_labels)
+                metrics.store_wal_size_bytes.set(
+                    self._wal.size_bytes, labels=self.metric_labels)
             except Exception:  # noqa: BLE001
                 pass
             faults.fire("store_crash")
@@ -416,10 +435,14 @@ class DurableClusterStore(ClusterStore):
     def _batch_begin(self) -> None:
         self._batch_depth += 1
 
-    def _batch_end(self) -> None:
+    def _batch_end(self, sync: bool = True) -> None:
         self._batch_depth -= 1
         if self._batch_depth == 0 and self._wal is not None:
-            self._wal.maybe_sync()  # ONE fsync for the whole batch
+            if sync:
+                self._wal.maybe_sync()  # ONE fsync for the whole batch
+            # sync=False: the sharded store owns the fsync — it runs one
+            # batch per touched shard and syncs every touched WAL in
+            # parallel afterwards (client/sharded.py _sync_shards)
             if self._records_since_snapshot >= self.snapshot_every:
                 self.snapshot()
 
@@ -437,7 +460,8 @@ class DurableClusterStore(ClusterStore):
                 "buckets": {k: [encode(o) for o in b.values()]
                             for k, b in self._buckets.items()},
             }
-            path = write_snapshot(self.data_dir, state)
+            path = write_snapshot(self.data_dir, state,
+                                  metric_labels=self.metric_labels)
             if self._wal is not None:
                 self._wal.close()
                 self._wal = self._open_segment()
@@ -449,7 +473,8 @@ class DurableClusterStore(ClusterStore):
         return WriteAheadLog(
             os.path.join(self.data_dir, f"wal-{self._rv:016d}.log"),
             fsync=self.fsync_policy,
-            fsync_interval_s=self.fsync_interval_s)
+            fsync_interval_s=self.fsync_interval_s,
+            metric_labels=self.metric_labels)
 
     def _prune(self) -> None:
         snaps = _snapshot_paths(self.data_dir)

@@ -20,6 +20,7 @@ CAS of utils.leader_election work across processes.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import random
@@ -34,6 +35,13 @@ from .server import MAGIC, raise_remote, recv_frame, remote_error, send_frame
 from .store import ResumeGapError
 
 log = logging.getLogger(__name__)
+
+#: bulk_apply chunking: an oversized wave splits into frames of at most
+#: this many encoded bytes / items each (one journal batch per chunk),
+#: so a 50k-pod wave can never produce a single multi-MB frame that
+#: trips the server's cap or stalls every other request behind it
+BULK_CHUNK_BYTES = 8 << 20
+BULK_CHUNK_ITEMS = 2048
 
 
 class RemoteClusterStore:
@@ -55,6 +63,11 @@ class RemoteClusterStore:
     - ``retry_attempts``/``retry_base_s``/``retry_cap_s``: idempotent-op
       retry budget (see _request) — defaults ride out a ~3 s server
       restart.
+    - ``pool_size``: request connections kept to the server (default 1,
+      the historical single-socket behavior). With N > 1, up to N
+      requests are in flight concurrently — the seam that lets fanned-
+      out controller workers ingest in parallel instead of queueing
+      behind one socket.
     """
 
     def __init__(self, address: str, connect_timeout: float = 5.0,
@@ -68,7 +81,8 @@ class RemoteClusterStore:
                  retry_cap_s: float = 2.0,
                  watch_resume: bool = True,
                  watch_resume_window_s: float = 30.0,
-                 watch_backoff_cap_s: float = 2.0):
+                 watch_backoff_cap_s: float = 2.0,
+                 pool_size: int = 1):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -115,8 +129,14 @@ class RemoteClusterStore:
         self.watch_backoff_cap_s = watch_backoff_cap_s
         self.watch_resumes = 0   # successful in-place stream resumes
         self._lock = threading.RLock()   # local mirror/listener lock
-        self._conn_lock = threading.Lock()  # serializes request/response
-        self._conn: Optional[socket.socket] = None
+        # request-connection pool: idle sockets ready for checkout, a
+        # live count capping concurrency at pool_size, and the full set
+        # so close() can unblock an in-flight recv
+        self.pool_size = max(1, int(pool_size))
+        self._pool_cv = threading.Condition()
+        self._idle: List[socket.socket] = []
+        self._n_conns = 0
+        self._conns: set = set()
         self._watch_threads: List[threading.Thread] = []
         self._watch_socks: List[socket.socket] = []
         self._closed = False
@@ -140,6 +160,50 @@ class RemoteClusterStore:
                 raise_remote(resp)
         return sock
 
+    def _acquire_conn(self) -> Optional[socket.socket]:
+        """Check a request connection out of the pool: an idle socket,
+        or None with a slot reserved (the caller connects outside the
+        pool lock). Blocks while pool_size requests are in flight."""
+        with self._pool_cv:
+            while True:
+                if self._closed:
+                    raise ConnectionError("store client closed")
+                if self._idle:
+                    return self._idle.pop()
+                if self._n_conns < self.pool_size:
+                    self._n_conns += 1
+                    return None
+                self._pool_cv.wait(0.1)
+
+    def _release_slot(self) -> None:
+        with self._pool_cv:
+            self._n_conns -= 1
+            self._pool_cv.notify()
+
+    def _drop_conn(self, sock: socket.socket) -> None:
+        """A connection died mid-request: close it, keep the slot (the
+        retry loop reconnects into it)."""
+        with self._pool_cv:
+            self._conns.discard(sock)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _checkin_conn(self, sock: socket.socket) -> None:
+        with self._pool_cv:
+            if self._closed:
+                self._conns.discard(sock)
+                self._n_conns -= 1
+            else:
+                self._idle.append(sock)
+            self._pool_cv.notify()
+        if self._closed:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def _request(self, payload: dict) -> dict:
         # Retry rules: a failed SEND is always safe to retry (the server
         # only acts on complete frames, and a broken connection can never
@@ -156,7 +220,8 @@ class RemoteClusterStore:
         # back off exponentially with jitter (base -> cap), so a
         # briefly-restarting server (a 2-second systemd bounce) is ridden
         # out — and a thundering herd of reconnecting clients spreads
-        # instead of synchronizing.
+        # instead of synchronizing. Connections come from a pool of
+        # pool_size (default 1 — the historical one-socket serialization).
         op = payload.get("op")
         idempotent = op in ("get", "list", "ping")
         conditional = op in ("create", "delete") or (
@@ -165,24 +230,24 @@ class RemoteClusterStore:
                      .get("resource_version")))
         delay = self.retry_base_s
         attempt = 0
-        with self._conn_lock:
+        sock = self._acquire_conn()
+        try:
             while True:
                 sent = False
                 try:
                     faults.fire("store_request")
-                    if self._conn is None:
-                        self._conn = self._connect()
-                    send_frame(self._conn, payload)
+                    if sock is None:
+                        sock = self._connect()
+                        with self._pool_cv:
+                            self._conns.add(sock)
+                    send_frame(sock, payload)
                     sent = True
-                    resp = recv_frame(self._conn)
+                    resp = recv_frame(sock)
                     break
                 except (ConnectionError, OSError):
-                    if self._conn is not None:
-                        try:
-                            self._conn.close()
-                        except OSError:
-                            pass
-                        self._conn = None
+                    if sock is not None:
+                        self._drop_conn(sock)
+                        sock = None
                     attempt += 1
                     if (sent and not (idempotent or conditional)) \
                             or attempt > self.retry_attempts \
@@ -195,6 +260,12 @@ class RemoteClusterStore:
                         pass
                     self._stop_event.wait(delay * (0.5 + random.random()))
                     delay = min(delay * 2.0, self.retry_cap_s)
+        except BaseException:
+            if sock is not None:
+                self._drop_conn(sock)
+            self._release_slot()
+            raise
+        self._checkin_conn(sock)
         if not resp.get("ok"):
             raise_remote(resp)
         return resp
@@ -202,13 +273,16 @@ class RemoteClusterStore:
     def close(self) -> None:
         self._closed = True
         self._stop_event.set()  # wake any backoff sleep immediately
-        with self._conn_lock:
-            if self._conn is not None:
-                try:
-                    self._conn.close()
-                except OSError:
-                    pass
-                self._conn = None
+        with self._pool_cv:
+            conns = list(self._conns)
+            self._conns.clear()
+            self._idle.clear()
+            self._pool_cv.notify_all()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
         for sock in self._watch_socks:
             try:
                 sock.shutdown(socket.SHUT_RDWR)
@@ -246,21 +320,63 @@ class RemoteClusterStore:
             {"op": "delete", "kind": kind, "name": name,
              "namespace": namespace, "fencing": fencing})["obj"])
 
-    def bulk_apply(self, items, fencing: Optional[dict] = None) -> List[Any]:
-        """Batch mutation in ONE frame each way (the ROADMAP item-3 bulk
-        ingest op): same contract as ClusterStore.bulk_apply — items are
-        (kind, obj[, verb]) and the result list carries the applied
-        object or the rebuilt exception instance per position. Not
-        retried after an unacked send (a bulk wave is not conditional as
-        a unit); a failed SEND retries like every other op."""
-        resp = self._request({
-            "op": "bulk_apply",
-            "items": [{"kind": it[0], "obj": encode(it[1]),
-                       "verb": it[2] if len(it) > 2 else "apply"}
-                      for it in items],
-            "fencing": fencing})
-        return [remote_error(r) if "error" in r else decode(r["obj"])
-                for r in resp["results"]]
+    def bulk_apply(self, items, fencing: Optional[dict] = None,
+                   chunk_bytes: int = BULK_CHUNK_BYTES,
+                   chunk_items: int = BULK_CHUNK_ITEMS,
+                   ack: bool = False) -> List[Any]:
+        """Batch mutation (the ROADMAP item-3 bulk ingest op): same
+        contract as ClusterStore.bulk_apply — items are (kind, obj[,
+        verb]) and the result list carries the applied object or the
+        rebuilt exception instance per position. An oversized wave is
+        CHUNKED: frames are bounded at chunk_bytes/chunk_items each,
+        every chunk commits as one journal batch server-side, and the
+        per-chunk results reassemble in submission order — a 50k-pod
+        wave costs a handful of bounded frames, never one giant one.
+        Not retried after an unacked send (a bulk wave is not
+        conditional as a unit); a failed SEND retries like every other
+        op, per chunk.
+
+        ``ack=True`` is ingest-wave mode: successful positions come
+        back as None instead of the applied objects (errors still
+        arrive as exception instances at their positions) — the server
+        skips encoding 10k result objects and this client skips
+        decoding them, roughly halving the wire cost of a pure-ingest
+        wave."""
+        encoded = []
+        for it in items:
+            d = {"kind": it[0], "obj": encode(it[1]),
+                 "verb": it[2] if len(it) > 2 else "apply"}
+            # sizing costs one extra dumps per item; the request frame
+            # re-serializes anyway, and bounded frames are what keep a
+            # mega-wave from stalling every other request on the server
+            encoded.append((d, len(json.dumps(d, separators=(",", ":")))))
+        results: List[Any] = []
+        i = 0
+        while i < len(encoded):
+            size = 0
+            j = i
+            while j < len(encoded) and (
+                    j == i or (j - i < chunk_items
+                               and size + encoded[j][1] <= chunk_bytes)):
+                size += encoded[j][1]
+                j += 1
+            payload = {"op": "bulk_apply",
+                       "items": [d for d, _ in encoded[i:j]],
+                       "fencing": fencing}
+            if ack:
+                payload["ack"] = True
+            resp = self._request(payload)
+            if ack:
+                chunk: List[Any] = [None] * int(resp["n"])
+                for idx, err in (resp.get("errors") or {}).items():
+                    chunk[int(idx)] = remote_error(err)
+                results.extend(chunk)
+            else:
+                results.extend(
+                    remote_error(r) if "error" in r else decode(r["obj"])
+                    for r in resp["results"])
+            i = j
+        return results
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None):
         return decode(self._request(
@@ -299,15 +415,40 @@ class RemoteClusterStore:
         contract as the in-memory store); live events are then delivered
         from a daemon reader thread under self.locked(). A broken stream
         resumes in place when it can (see class docstring)."""
+        self._start_stream({kind: [listener]}, "watch", replay)
+
+    def bulk_watch(self, subscriptions, replay: bool = True) -> None:
+        """Subscribe MANY kinds over ONE streaming connection (the
+        ``bulk_watch`` wire op): ``subscriptions`` is an ordered iterable
+        of ``(kind, listener)`` — a kind may appear more than once, its
+        listeners fan out in subscription order. Replays land inline per
+        kind, in subscription order, before this returns; live events
+        then arrive BATCHED (the server coalesces up to
+        WATCH_BATCH_MAX events per frame) and are applied under one
+        mirror-lock hold per batch. Resume carries a per-shard
+        high-water-mark map per kind ({kind: {shard: rv}}), so a stream
+        against the sharded router reconnects without skipping or
+        repeating any shard's events."""
+        subs: Dict[str, List] = {}
+        for kind, listener in subscriptions:
+            subs.setdefault(kind, []).append(listener)
+        self._start_stream(subs, "bulk_watch", replay)
+
+    def _start_stream(self, subs: Dict[str, List], op: str,
+                      replay: bool) -> None:
         sock = self._connect()
         # register BEFORE the replay loop: close() must be able to unblock
         # a watch() stuck mid-replay on a stalled server
         self._watch_socks.append(sock)
-        send_frame(sock, {"op": "watch", "kinds": [kind], "replay": replay})
-        state = {"hwm": -1}  # per-kind resume high-water mark
+        kinds = list(subs)
+        send_frame(sock, {"op": op, "kinds": kinds, "replay": replay})
+        # per-kind, per-shard resume high-water marks; "sharded" flips
+        # once any frame carries shard structure, switching the resume
+        # request from the legacy scalar form to the per-shard map
+        state = {"hwm": {}, "sharded": False}
+        desc = kinds[0] if len(kinds) == 1 else f"bulk({','.join(kinds)})"
         try:
-            self._apply_stream(sock, kind, listener, state,
-                               until_synced=True)
+            self._apply_stream(sock, subs, state, until_synced=True)
         except Exception:
             # server refused the subscription (e.g. unknown kind) or died
             # mid-replay: surface it to the caller, nothing to resume yet
@@ -318,42 +459,56 @@ class RemoteClusterStore:
             cur = sock
             while True:
                 try:
-                    self._apply_stream(cur, kind, listener, state,
+                    self._apply_stream(cur, subs, state,
                                        until_synced=False)
                 except (ConnectionError, OSError, ValueError) as e:
                     self._drop_watch_sock(cur)
                     if self._closed:
                         return
-                    cur = self._resume_watch(kind, listener, state)
+                    cur = self._resume_watch(subs, op, state, desc)
                     if cur is None:
                         # a resume abandoned because close() landed
                         # mid-attempt is a clean shutdown, not a broken
                         # mirror — don't fire the crash-only contract
                         if not self._closed:
-                            self._watch_broke(kind, e)
+                            self._watch_broke(desc, e)
                         return
                     continue
                 except Exception as e:  # noqa: BLE001 — a listener blew up
                     # mid-handler: the mirror itself may be inconsistent,
                     # which no stream resume can repair — crash-only
-                    log.exception("watch listener for %s failed", kind)
+                    log.exception("watch listener for %s failed", desc)
                     self._drop_watch_sock(cur)
                     if not self._closed:
-                        self._watch_broke(kind, e)
+                        self._watch_broke(desc, e)
                     return
 
         t = threading.Thread(target=reader, daemon=True,
-                             name=f"store-watch-{kind}")
+                             name=f"store-watch-{desc}")
         t.start()
         self._watch_threads.append(t)
 
-    def _apply_stream(self, sock, kind: str, listener, state: dict,
+    @staticmethod
+    def _advance_hwm(state: dict, kind: str, val) -> None:
+        """Fold a synced-frame rv value — the legacy scalar, or the
+        router's per-shard map — into the resume high-water marks."""
+        hk = state["hwm"].setdefault(kind, {})
+        if isinstance(val, dict):
+            state["sharded"] = True
+            for sh, rv in val.items():
+                if rv is not None:
+                    hk[str(sh)] = max(hk.get(str(sh), -1), int(rv))
+        elif val is not None:
+            hk["0"] = max(hk.get("0", -1), int(val))
+
+    def _apply_stream(self, sock, subs: Dict[str, List], state: dict,
                       until_synced: bool) -> None:
         """Read frames from a watch socket, delivering events under the
-        mirror lock and advancing the resume high-water mark atomically
+        mirror lock and advancing the resume high-water marks atomically
         with each delivery (so a resume never skips or repeats an event).
-        Returns at the 'synced' marker when ``until_synced``, else loops
-        until the connection dies."""
+        Handles per-event frames and the bulk_watch batched form (one
+        lock hold per batch). Returns at the 'synced' marker when
+        ``until_synced``, else loops until the connection dies."""
         while True:
             msg = recv_frame(sock)
             faults.fire("watch_stream")
@@ -361,14 +516,19 @@ class RemoteClusterStore:
                 raise_remote(msg)
             stream = msg.get("stream")
             if stream == "synced":
-                rv = (msg.get("rv") or {}).get(kind)
-                if rv is not None:
-                    with self._lock:
-                        state["hwm"] = max(state["hwm"], int(rv))
+                rvmap = msg.get("rv") or {}
+                with self._lock:
+                    for kind in subs:
+                        if kind in rvmap:
+                            self._advance_hwm(state, kind, rvmap[kind])
                 if until_synced:
                     return
                 continue
-            if stream != "event":
+            if stream == "events":
+                batch = msg.get("batch") or []
+            elif stream == "event":
+                batch = [msg]
+            else:
                 continue  # heartbeat
             # under self._lock like every delivery: during the cache's
             # sequential subscriptions (nodes, then pods, ...) a LIVE
@@ -376,40 +536,58 @@ class RemoteClusterStore:
             # mirror concurrently with a later kind's replay — cache
             # handlers rely on the store serializing dispatch
             with self._lock:
-                self._deliver(listener, msg)
-                rv = msg.get("rv")
-                if rv is not None:
-                    state["hwm"] = max(state["hwm"], int(rv))
+                for ev in batch:
+                    kind = ev.get("kind")
+                    fns = subs.get(kind)
+                    if fns:
+                        old = ev.get("old")
+                        obj = decode(ev["obj"])
+                        oldo = decode(old) if old is not None else None
+                        for fn in fns:
+                            fn(ev["event"], obj, oldo)
+                    rv = ev.get("rv")
+                    if rv is not None:
+                        shard = ev.get("shard")
+                        if shard is not None:
+                            state["sharded"] = True
+                        hk = state["hwm"].setdefault(kind, {})
+                        sh = str(shard) if shard is not None else "0"
+                        hk[sh] = max(hk.get(sh, -1), int(rv))
 
-    def _resume_watch(self, kind: str, listener, state: dict):
+    def _resume_watch(self, subs: Dict[str, List], op: str, state: dict,
+                      desc: str):
         """Reconnect a broken watch stream with exponential backoff +
-        jitter and ask the server to replay from our high-water mark.
+        jitter and ask the server to replay from our high-water marks.
         Returns the new streaming socket (mirror already resynced), or
         None when resume is impossible — unknown high-water mark, resume
         window lost server-side (ResumeGapError), or the server stayed
         unreachable past ``watch_resume_window_s`` — in which case the
         caller falls back to the crash-only contract."""
-        hwm = state["hwm"]
-        if not self.watch_resume or hwm < 0:
-            return None
+        with self._lock:
+            if not self.watch_resume or any(
+                    not state["hwm"].get(k) for k in subs):
+                return None
         deadline = time.monotonic() + self.watch_resume_window_s
         delay = 0.05
         attempt = 0
         while not self._closed:
             attempt += 1
             sock = None
+            with self._lock:
+                since = ({k: dict(m) for k, m in state["hwm"].items()}
+                         if state["sharded"] else
+                         {k: m.get("0", -1)
+                          for k, m in state["hwm"].items()})
             try:
                 sock = self._connect()
                 self._watch_socks.append(sock)
-                send_frame(sock, {"op": "watch", "kinds": [kind],
-                                  "replay": False,
-                                  "since": {kind: state["hwm"]}})
+                send_frame(sock, {"op": op, "kinds": list(subs),
+                                  "replay": False, "since": since})
                 # the missed-event replay lands here, inline
-                self._apply_stream(sock, kind, listener, state,
-                                   until_synced=True)
+                self._apply_stream(sock, subs, state, until_synced=True)
             except ResumeGapError as e:
                 self._drop_watch_sock(sock)
-                log.error("watch stream for %r cannot resume: %s", kind, e)
+                log.error("watch stream for %r cannot resume: %s", desc, e)
                 return None
             except (ConnectionError, OSError, ValueError):
                 self._drop_watch_sock(sock)
@@ -422,11 +600,11 @@ class RemoteClusterStore:
                 self.watch_resumes += 1
             try:
                 from ..metrics import metrics
-                metrics.watch_reconnects_total.inc(labels={"kind": kind})
+                metrics.watch_reconnects_total.inc(labels={"kind": desc})
             except Exception:  # noqa: BLE001
                 pass
-            log.warning("watch stream for %r resumed from rv %s "
-                        "(attempt %d)", kind, hwm, attempt)
+            log.warning("watch stream for %r resumed from %s "
+                        "(attempt %d)", desc, since, attempt)
             return sock
         return None
 
@@ -458,9 +636,3 @@ class RemoteClusterStore:
                 self.on_watch_failure()
             except Exception:  # noqa: BLE001 — never kill the reader hook
                 log.exception("on_watch_failure callback failed")
-
-    @staticmethod
-    def _deliver(listener, msg: dict) -> None:
-        old = msg.get("old")
-        listener(msg["event"], decode(msg["obj"]),
-                 decode(old) if old is not None else None)

@@ -41,7 +41,7 @@ import hmac
 from .codec import decode, encode
 from .store import (
     KINDS, AdmissionError, ClusterStore, ConflictError, FencedError,
-    NotFoundError, ResumeGapError,
+    NotFoundError, ResumeGapError, ShardUnavailableError,
 )
 
 log = logging.getLogger(__name__)
@@ -52,6 +52,7 @@ WATCH_QUEUE_MAX = 65536     # pending events before a slow watcher drops
 WATCH_SEND_TIMEOUT_S = 30.0
 TLS_HANDSHAKE_TIMEOUT_S = 10.0
 JOURNAL_CAPACITY = 4096     # per-kind resume window (events)
+WATCH_BATCH_MAX = 256       # events coalesced per bulk_watch frame
 
 _ERRORS = {
     "ConflictError": ConflictError,
@@ -59,6 +60,7 @@ _ERRORS = {
     "AdmissionError": AdmissionError,
     "ResumeGapError": ResumeGapError,
     "FencedError": FencedError,
+    "ShardUnavailableError": ShardUnavailableError,
 }
 
 
@@ -99,7 +101,13 @@ class EventJournal:
                 self._events[kind] = collections.deque()
                 self._floor[kind] = store.last_event_rv(kind)
                 tail = seed.get(kind)
-                if tail:
+                # trust the recovered tail only when it reaches the
+                # store's PRESENT rv for this kind: a journal built some
+                # time after recovery (events committed in between) has
+                # a hole the tail cannot cover, and resuming across it
+                # would silently skip those events — keep the floor at
+                # the current rv instead (resumes from before it refuse)
+                if tail and tail[-1][0] >= store.last_event_rv(kind):
                     self._floor[kind] = int(floors.get(kind, 0))
                     q = self._events[kind]
                     for entry in tail:
@@ -145,6 +153,14 @@ def send_frame(sock: socket.socket, payload: dict) -> None:
     sock.sendall(struct.pack("<I", len(raw)) + raw)
 
 
+def send_frame_raw(sock: socket.socket, raw: bytes) -> None:
+    """Send an already-serialized frame (the watch hub serializes each
+    event once; every stream then ships the same bytes)."""
+    if len(raw) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(raw)} bytes exceeds cap")
+    sock.sendall(struct.pack("<I", len(raw)) + raw)
+
+
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -172,6 +188,76 @@ def remote_error(resp: dict) -> Exception:
 def raise_remote(resp: dict) -> None:
     """Re-raise a {"ok": false} response as its original error class."""
     raise remote_error(resp)
+
+
+def since_rv(val) -> int:
+    """A resume high-water mark out of a ``since:`` request: the legacy
+    scalar, or the per-shard map ({shard: rv}) a shard-aware client
+    sends — the unsharded server IS shard "0", so it resumes from that
+    entry and ignores the rest (there are none to ignore unless the
+    client migrated from a sharded endpoint, in which case an absent
+    "0" refuses conservatively)."""
+    if isinstance(val, dict):
+        val = val.get("0", -1)
+    return int(val if val is not None else -1)
+
+
+def pump_watch(sock: socket.socket, events: "queue.Queue",
+               overflowed: threading.Event, batch_max: int = 1,
+               on_sent=None) -> None:
+    """Drain a watch queue onto the socket until the watcher is
+    condemned (overflow) or the peer goes away (raises). With
+    ``batch_max`` > 1 consecutive event payloads coalesce into one
+    ``{"stream": "events", "batch": [...]}`` frame — the bulk_watch
+    contract: at tens of thousands of events per second, per-event
+    frames spend more wall time in framing + syscalls than in the
+    events themselves. Control frames (synced/heartbeat) always flush
+    the pending batch first, so ordering is preserved.
+
+    An event payload may carry ``_raw`` — its own frame bytes,
+    serialized ONCE by the producer (the shard router's watch hub) —
+    in which case this pump ships/concatenates those bytes instead of
+    re-serializing per stream."""
+    def event_bytes(p) -> str:
+        raw = p.get("_raw")
+        return raw if raw is not None else json.dumps(p)
+
+    while not overflowed.is_set():
+        try:
+            payload = events.get(timeout=10.0)
+        except queue.Empty:
+            # heartbeat: an idle cluster would otherwise never touch
+            # the socket, so a dead peer's listener would stay
+            # subscribed forever
+            payload = {"stream": "heartbeat"}
+        if batch_max > 1 and payload.get("stream") == "event":
+            batch = [payload]
+            tail = None
+            while len(batch) < batch_max:
+                try:
+                    nxt = events.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt.get("stream") == "event":
+                    batch.append(nxt)
+                else:
+                    tail = nxt
+                    break
+            send_frame_raw(sock, (
+                '{"stream":"events","batch":['
+                + ",".join(event_bytes(p) for p in batch)
+                + "]}").encode())
+            if on_sent is not None:
+                on_sent(batch)
+            if tail is not None:
+                send_frame(sock, tail)
+            continue
+        if payload.get("stream") == "event":
+            send_frame_raw(sock, event_bytes(payload).encode())
+            if on_sent is not None:
+                on_sent([payload])
+        else:
+            send_frame(sock, payload)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -218,14 +304,21 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 req = recv_frame(sock)
                 op = req.get("op")
-                if op == "watch":
+                if op in ("watch", "bulk_watch"):
                     self._serve_watch(sock, store, req)
                     return  # watch connections never go back to req/resp
                 try:
                     resp = self._dispatch(store, op, req)
-                except (ConflictError, NotFoundError, AdmissionError) as e:
+                except (ConflictError, NotFoundError, AdmissionError,
+                        ShardUnavailableError) as e:
                     resp = {"ok": False, "error": type(e).__name__,
                             "message": str(e)}
+                except ConnectionError:
+                    # transport-shaped failure inside dispatch (the
+                    # shard_request/shard_crash fault points inject
+                    # these): die like the link did, so the client's
+                    # retry rules engage instead of its error handling
+                    raise
                 except Exception as e:  # noqa: BLE001 — report, keep serving
                     log.exception("store op %s failed", op)
                     resp = {"ok": False, "error": "RuntimeError",
@@ -264,8 +357,18 @@ class _Handler(socketserver.BaseRequestHandler):
             # rejected object costs that object, not the wave
             items = [(it["kind"], decode(it["obj"]),
                       it.get("verb", "apply")) for it in req["items"]]
+            results = store.bulk_apply(items, fencing=fencing)
+            if req.get("ack"):
+                # ingest-wave mode: the caller doesn't want the applied
+                # objects back — respond with counts + sparse errors, so
+                # a 10k-pod wave costs no result encode/decode at all
+                errors = {str(i): {"error": type(r).__name__,
+                                   "message": str(r)}
+                          for i, r in enumerate(results)
+                          if isinstance(r, Exception)}
+                return {"ok": True, "n": len(results), "errors": errors}
             out = []
-            for res in store.bulk_apply(items, fencing=fencing):
+            for res in results:
                 if isinstance(res, Exception):
                     out.append({"error": type(res).__name__,
                                 "message": str(res)})
@@ -304,6 +407,9 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         replay = bool(req.get("replay", True))
         since = req.get("since") or None  # {kind: rv} = resume request
+        # bulk_watch: same subscription semantics, but events coalesce
+        # into batched frames (pump_watch) — the high-churn ingest path
+        batch_max = WATCH_BATCH_MAX if req.get("op") == "bulk_watch" else 1
         journal: Optional[EventJournal] = getattr(self.server, "journal",
                                                   None)
         # bounded queue + send timeout: a peer that stalls without closing
@@ -345,7 +451,8 @@ class _Handler(socketserver.BaseRequestHandler):
             with store.locked():
                 if since is not None:
                     for kind in kinds:
-                        missed = journal.since(kind, int(since.get(kind, -1))) \
+                        missed = journal.since(kind,
+                                               since_rv(since.get(kind))) \
                             if journal is not None else None
                         if missed is None:
                             gap_kind = kind
@@ -371,15 +478,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     "message": f"resume window for {gap_kind!r} no longer "
                                f"covers rv {since.get(gap_kind)}"})
                 return
-            while not overflowed.is_set():
-                try:
-                    payload = events.get(timeout=10.0)
-                except queue.Empty:
-                    # heartbeat: an idle cluster would otherwise never
-                    # touch the socket, so a dead peer's listener would
-                    # stay subscribed forever
-                    payload = {"stream": "heartbeat"}
-                send_frame(sock, payload)
+            pump_watch(sock, events, overflowed, batch_max=batch_max)
             log.warning("watch stream overflowed %d events; dropping the "
                         "slow watcher", WATCH_QUEUE_MAX)
             try:
@@ -421,6 +520,10 @@ class StoreServer:
     run inside a network layer that encrypts, e.g. a service mesh);
     webhooks.server.generate_self_signed_cert bootstraps a dev pair."""
 
+    #: request handler; the shard router (client/sharded.py) subclasses
+    #: with shard-aware watch serving over the same wire protocol
+    handler_class = _Handler
+
     def __init__(self, store: ClusterStore, host: str = "127.0.0.1",
                  port: int = 0, token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
@@ -445,12 +548,13 @@ class StoreServer:
                 "store TLS needs BOTH tls_cert and tls_key "
                 "(tls_client_ca additionally needs them)")
 
-        self._server = _Server((host, port), _Handler)
+        self._server = _Server((host, port), self.handler_class)
         self._server.store = store  # type: ignore[attr-defined]
         self._server.token = token or ""  # type: ignore[attr-defined]
         self._server.ssl_ctx = ssl_ctx  # type: ignore[attr-defined]
-        # resume window for reconnecting watchers (see EventJournal)
-        self.journal = EventJournal(store)
+        # resume window for reconnecting watchers (see EventJournal;
+        # the shard router builds one journal per shard instead)
+        self.journal = self._make_journal(store)
         self._server.journal = self.journal  # type: ignore[attr-defined]
         # live connection sockets, so stop() drops watch streams too
         # (daemon handler threads outlive server_close otherwise and
@@ -458,6 +562,9 @@ class StoreServer:
         self._server.active = set()  # type: ignore[attr-defined]
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+
+    def _make_journal(self, store):
+        return EventJournal(store)
 
     @property
     def address(self) -> str:

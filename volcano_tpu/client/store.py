@@ -57,6 +57,13 @@ class ResumeGapError(Exception):
     client falls back to its crash-only resync path."""
 
 
+class ShardUnavailableError(Exception):
+    """The store shard owning the requested object is down (crashed and
+    not yet recovered). Per-item containment applies: in a bulk wave the
+    down shard's items carry this error while the other shards' items
+    commit — a dead shard costs its objects, not the wave."""
+
+
 def _key(obj) -> str:
     ns = getattr(obj, "namespace", None)
     return f"{ns}/{obj.name}" if ns is not None else obj.name
@@ -145,8 +152,18 @@ class ClusterStore:
         re-acquired since (epoch = lease_transitions at acquisition), or
         expired by the store's own clock (split-brain where no standby has
         taken over yet must still not commit). Unfenced writes (no token)
-        pass untouched: fencing is opt-in per writer via FencedStore."""
+        pass untouched: fencing is opt-in per writer via FencedStore.
+
+        A sharded member store delegates to its fence arbiter (the shard
+        holding the "leases" bucket, client/sharded.py): a pod write on
+        shard 3 is arbitrated by the lease record on shard 0 — the
+        sharded store's top-level mutation mutex makes the check atomic
+        with the write, exactly like this store's own lock does."""
         if not fencing:
+            return
+        arbiter = getattr(self, "_fence_arbiter", None)
+        if arbiter is not None:
+            arbiter._check_fence(fencing)
             return
         name = fencing.get("lock", "")
         lease = self._buckets["leases"].get(name)
@@ -243,7 +260,8 @@ class ClusterStore:
             self._notify(kind, "delete", obj)
             return obj
 
-    def bulk_apply(self, items, fencing: Optional[dict] = None) -> List[Any]:
+    def bulk_apply(self, items, fencing: Optional[dict] = None,
+                   _sync: bool = True) -> List[Any]:
         """Batch mutation: many objects under ONE lock hold (and, on the
         durable store, one journal batch — a single fsync covers the
         whole wave). ``items`` is an iterable of ``(kind, obj)`` or
@@ -255,7 +273,12 @@ class ClusterStore:
         applied object OR the exception instance at that item's position
         — a rejected pod in a 500-pod ingest wave costs that pod, not
         the wave. The wire op (StoreServer ``bulk_apply``) carries the
-        same contract in one frame each way."""
+        same contract in one frame each way.
+
+        ``_sync=False`` defers the batch-end fsync to the caller (the
+        sharded store runs one batch per touched shard and then fsyncs
+        every touched WAL in parallel — N shards cost one fsync's wall
+        time, not N)."""
         results: List[Any] = []
         with self._lock:
             self._batch_begin()
@@ -280,14 +303,14 @@ class ClusterStore:
                     except Exception as e:  # noqa: BLE001 — per-item result
                         results.append(e)
             finally:
-                self._batch_end()
+                self._batch_end(sync=_sync)
         return results
 
     def _batch_begin(self) -> None:
         """Journal-batch seam (no-op in memory; the durable store defers
         fsync until _batch_end so a bulk write costs one sync)."""
 
-    def _batch_end(self) -> None:
+    def _batch_end(self, sync: bool = True) -> None:
         pass
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None):

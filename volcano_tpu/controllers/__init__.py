@@ -15,17 +15,48 @@ from .podgroup import PodGroupController  # noqa: F401
 from .queue import QueueController  # noqa: F401
 
 
+class _WatchCollector:
+    """Stands in for the cluster while a controller's run() subscribes:
+    records (kind, listener) pairs instead of opening per-kind streams,
+    so the manager can open them all as ONE bulk_watch stream. Every
+    other attribute forwards to the real cluster."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.subs = []
+
+    def watch(self, kind, listener, replay: bool = True) -> None:
+        self.subs.append((kind, listener))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 class ControllerManager:
     """cmd/controller-manager equivalent: initialize + run all controllers
     against one cluster store; process_all() drains every controller's
-    queue (single-core stand-in for the per-controller worker loops)."""
+    queue (single-core stand-in for the per-controller worker loops).
+
+    Scale knobs (the sharded-front-door fan-out, ROADMAP item 3):
+    ``bulk_watch=True`` collects every controller's subscriptions and
+    opens them as ONE bulk_watch stream when the cluster supports it
+    (RemoteClusterStore against a store server/router) — one socket and
+    batched frames instead of a dozen per-kind streams.
+    ``shard_workers=N`` fans the job controller's sync drain out across
+    N worker threads partitioned by the job key's store shard, so
+    pod-wave ingest overlaps store round trips instead of queueing
+    behind one request at a time (pair with the store client's
+    ``pool_size``)."""
 
     def __init__(self, cluster, scheduler_name: str = "volcano",
-                 default_queue: str = "default", worker_num: int = 3):
+                 default_queue: str = "default", worker_num: int = 3,
+                 shard_workers: int = 1, bulk_watch: bool = False):
         self.opt = ControllerOption(cluster=cluster,
                                     scheduler_name=scheduler_name,
                                     default_queue=default_queue,
                                     worker_num=worker_num)
+        self.shard_workers = max(1, int(shard_workers))
+        self.bulk_watch = bool(bulk_watch)
         self.controllers = [
             JobController(),
             QueueController(),
@@ -37,13 +68,38 @@ class ControllerManager:
             ctrl.initialize(self.opt)
 
     def run(self) -> None:
+        if self.bulk_watch and hasattr(self.opt.cluster, "bulk_watch"):
+            subs = []
+            for ctrl in self.controllers:
+                orig = getattr(ctrl, "cluster", None)
+                if orig is None:
+                    ctrl.run()
+                    continue
+                collector = _WatchCollector(orig)
+                ctrl.cluster = collector
+                try:
+                    ctrl.run()
+                finally:
+                    ctrl.cluster = orig
+                subs.extend(collector.subs)
+            if subs:
+                # one stream for every controller: replays land per kind
+                # in subscription order (same net deliveries as the
+                # sequential per-controller subscriptions), live events
+                # arrive batched
+                self.opt.cluster.bulk_watch(subs)
+            return
         for ctrl in self.controllers:
             ctrl.run()
 
     def process_all(self, rounds: int = 4) -> None:
         for _ in range(rounds):
             for ctrl in self.controllers:
-                ctrl.process_all()
+                if self.shard_workers > 1 and isinstance(ctrl,
+                                                         JobController):
+                    ctrl.process_all(parallel=self.shard_workers)
+                else:
+                    ctrl.process_all()
 
     def run_with_leader_election(self, stop, lock_name: str = "vc-controller-manager",
                                  identity: str = None) -> None:

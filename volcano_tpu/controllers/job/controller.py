@@ -157,14 +157,22 @@ class JobController(Controller):
             batch.setdefault(dedup, req)
         self._deferred = still_waiting
 
-    def process_all(self, max_rounds: int = 16) -> None:
+    def process_all(self, max_rounds: int = 16, parallel: int = 1) -> None:
         """Drain all shards; new requests produced while processing are
         handled in subsequent rounds. Identical requests are deduplicated
         per round (the reference's workqueue add-if-absent semantics) —
         without this, the watch-event feedback from each sync amplifies the
         queue exponentially. A request whose sync raises re-enqueues with
         capped exponential backoff per job key (_retry_later) instead of
-        being dropped (or hot-looped)."""
+        being dropped (or hot-looped).
+
+        ``parallel`` > 1 fans a round's batch out across worker threads
+        partitioned by the job key's STORE shard (client/sharded.py
+        shard_for — the sharded front door's controller fan-out):
+        requests for one job keep their key affinity in one worker,
+        while workers whose syncs are store round trips overlap instead
+        of queueing behind a single request at a time. Retry-backoff
+        bookkeeping stays on the caller thread."""
         for _ in range(max_rounds):
             batch: Dict[tuple, Request] = {}
             for q in self.queues:
@@ -176,14 +184,52 @@ class JobController(Controller):
             self._drain_due_retries(batch)
             if not batch:
                 return
-            for req in batch.values():
+            if parallel <= 1 or len(batch) <= 1:
+                for req in batch.values():
+                    try:
+                        self._process(req)
+                    except Exception:
+                        log.exception("failed to process request %s", req)
+                        self._retry_later(req)
+                    else:
+                        self._retry_counts.pop(req.key, None)
+                continue
+            self._process_parallel(batch, parallel)
+
+    def _process_parallel(self, batch: Dict[tuple, Request],
+                          parallel: int) -> None:
+        import threading
+
+        from ...client.sharded import shard_for
+
+        groups: Dict[int, List[Request]] = {}
+        for req in batch.values():
+            groups.setdefault(shard_for("jobs", req.key, parallel),
+                              []).append(req)
+        failed: List[Request] = []
+        synced: List[str] = []
+
+        def drain(reqs: List[Request]) -> None:
+            for req in reqs:
                 try:
                     self._process(req)
-                except Exception:
+                except Exception:  # noqa: BLE001 — retried below
                     log.exception("failed to process request %s", req)
-                    self._retry_later(req)
+                    failed.append(req)
                 else:
-                    self._retry_counts.pop(req.key, None)
+                    synced.append(req.key)
+
+        threads = [threading.Thread(target=drain, args=(reqs,),
+                                    name=f"job-sync-{shard}")
+                   for shard, reqs in groups.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for req in failed:
+            self._retry_later(req)
+        for key in synced:
+            self._retry_counts.pop(key, None)
 
     # -- watch handlers (job_controller_handler.go) ---------------------------
 

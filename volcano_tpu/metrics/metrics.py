@@ -367,6 +367,29 @@ store_wal_recovery_records = registry.register(Gauge(
     "volcano_store_wal_recovery_records",
     "WAL records replayed on top of the snapshot by the last recovery"))
 
+# -- sharded store metrics (client/sharded.py) ------------------------------
+# the volcano_store_wal_* family above additionally carries a
+# shard=<idx> label when the WAL belongs to a sharded member store
+
+store_shard_events_total = registry.register(Counter(
+    "volcano_store_shard_events_total",
+    "Events committed per store shard (rate = per-shard events/sec)",
+    ["shard"]))
+store_shard_journal_window = registry.register(Gauge(
+    "volcano_store_shard_journal_window",
+    "Events currently replayable from one shard's watch-resume journal "
+    "(the span of its since: window, sampled every 64 commits)",
+    ["shard"]))
+store_shard_watch_queue_depth = registry.register(Gauge(
+    "volcano_store_shard_watch_queue_depth",
+    "Events from one shard sitting in router watch queues, not yet on "
+    "the wire (sustained growth = a slow watcher about to be dropped)",
+    ["shard"]))
+store_shard_dropped_total = registry.register(Counter(
+    "volcano_store_shard_dropped_events_total",
+    "Events discarded per shard when a condemned (overflowed/stalled) "
+    "watch stream was dropped", ["shard"]))
+
 # -- global rescheduler metrics (reschedule/) -------------------------------
 
 reschedule_plans_total = registry.register(Counter(

@@ -52,7 +52,16 @@ a slow disk, ``exc:`` for an fsync failure surfacing to the writer), and
 and before the commit is announced to listeners/clients — arm
 ``exc:exit`` to kill the store process with the record durable but the
 response never sent, the ambiguous crash the conditional-retry rules in
-client/remote.py exist for).
+client/remote.py exist for), ``shard_request`` (ShardRouter wire
+dispatch, before the routed op touches any shard — the injected
+ConnectionError kills that connection the way a dropped shard link
+would, so the client's transport-retry rules engage, not its error
+handling), and ``shard_crash`` (ShardedClusterStore commit seam: once
+per routed mutation, and once per touched shard inside a bulk wave —
+arm ``exc:exit`` in a sharded store process to SIGKILL it with some
+shards' sub-batches durable and others not, so recovery must heal every
+per-shard WAL lineage; for killing ONE shard in-process, see
+ShardedClusterStore.crash_shard/recover_shard).
 """
 
 from __future__ import annotations
