@@ -70,11 +70,12 @@ def main():
         st = pstats.Stats(pr)
         st.sort_stats("cumulative").print_stats(50)
         st.sort_stats("tottime").print_stats(30)
-        print("timing", {k: round(v, 2)
+        print("timing", {k: (round(v, 2) if isinstance(v, float) else v)
                          for k, v in sched.last_cycle_timing.items()})
         return
 
-    lats, host = [], []
+    lats, host, flat_modes = [], [], []
+    patch_ms, full_ms = [], []
     for s in range(8):
         for w in range(10):
             make_wave(store, wave)
@@ -84,10 +85,28 @@ def main():
         lats.append((time.perf_counter() - t0) * 1e3)
         t = sched.last_cycle_timing
         host.append(t["total_ms"] - t.get("solve_ms", 0.0))
+        # event-sourced flatten trace: which assembly mode each cycle
+        # took and the patch-vs-full flatten latency split (BENCH_r0x
+        # artifacts track these series)
+        flat_modes.append((t.get("flatten_mode", "?"),
+                           int(t.get("flatten_rows_patched", 0)),
+                           int(t.get("flatten_events_applied", 0)),
+                           t.get("flatten_fallback_reason", "")))
+        if "flatten_patch_ms" in t:
+            patch_ms.append(t["flatten_patch_ms"])
+        if "flatten_full_ms" in t:
+            full_ms.append(t["flatten_full_ms"])
         sched._maybe_gc()
     print("steady p50", round(float(np.percentile(lats, 50)), 2),
           "host p50", round(float(np.percentile(host, 50)), 2))
-    print("timing", {k: round(v, 2)
+    print("flatten modes (mode, rows, events, fallback):", flat_modes)
+    print("flatten patch ms", [round(x, 2) for x in patch_ms],
+          "p50", round(float(np.percentile(patch_ms, 50)), 2)
+          if patch_ms else None)
+    print("flatten full ms", [round(x, 2) for x in full_ms],
+          "p50", round(float(np.percentile(full_ms, 50)), 2)
+          if full_ms else None)
+    print("timing", {k: (round(v, 2) if isinstance(v, float) else v)
                      for k, v in sched.last_cycle_timing.items()})
 
 

@@ -450,6 +450,9 @@ class TestStoreTLS:
 
     @pytest.fixture()
     def certs(self, tmp_path):
+        # cert generation needs pyca/cryptography, which the runtime
+        # image may not carry — TLS coverage skips cleanly there
+        pytest.importorskip("cryptography")
         from volcano_tpu.webhooks.server import generate_self_signed_cert
         cert, key = generate_self_signed_cert(str(tmp_path / "a"))
         cert2, key2 = generate_self_signed_cert(str(tmp_path / "b"))
@@ -898,6 +901,7 @@ class TestVcctlTLSFlags:
     def test_vcctl_applies_over_tls_with_flags(self, tmp_path):
         """vcctl --server --token --tls-ca drives a TLS-served store
         (the deployed-control-plane path with encryption on)."""
+        pytest.importorskip("cryptography")
         from volcano_tpu.cli.vcctl import main as vcctl
         from volcano_tpu.webhooks.server import generate_self_signed_cert
 

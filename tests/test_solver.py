@@ -465,6 +465,201 @@ class TestFlattenIncrementalIdentity:
         self._assert_packed_identical(fc, jobs, nodes, tasks, queues)
 
 
+class TestFlattenEventIdentity(TestFlattenIncrementalIdentity):
+    """The event-sourced flatten (dirty rows marked by a fed ledger,
+    patched in place at cycle start) must stay byte-identical to a cold
+    flatten across seeded churn — adds, deletes, binds, node drains,
+    job-layout crossings, bucket resizes — including the cycle after a
+    deliberately dropped/duplicated ledger delta forces the epoch-check
+    fallback. Inherits the incremental matrix's builders; every mutation
+    here is paired with the feed the SchedulerCache hooks would emit."""
+
+    def _fed_cache(self):
+        from volcano_tpu.ops import FlattenCache
+
+        fc = FlattenCache()
+        fc.enable_events()
+        return fc
+
+    def test_identity_across_seeded_churn(self):
+        import random
+
+        from volcano_tpu.ops import FlattenCache
+
+        rng = random.Random(11)
+        jobs, nodes, tasks_by_job, queues = self._build(8)
+        fc = self._fed_cache()
+        held = {}
+
+        def snap():
+            jobs_s = dict(jobs)
+            tasks_s = [t for u in jobs_s
+                       for t in tasks_by_job[u]
+                       if t.status == TaskStatus.PENDING]
+            return jobs_s, tasks_s
+
+        modes = []
+
+        def check():
+            jobs_s, tasks_s = snap()
+            self._assert_packed_identical(fc, jobs_s, nodes, tasks_s,
+                                          queues)
+            modes.append(fc.last_flatten_mode)
+
+        check()                     # cold baseline
+        check()                     # quiet: event mode, zero rows
+        assert fc.last_flatten_mode == "event"
+        assert fc.last_rows_patched == 0
+
+        next_job = [100]
+
+        def churn_once():
+            op = rng.choice(["bind", "acct", "acct", "minavail", "quiet",
+                             "add_job", "del_job", "drain", "spec"])
+            if op == "bind":
+                uid = rng.choice(list(jobs))
+                pend = [t for t in tasks_by_job[uid]
+                        if t.status == TaskStatus.PENDING]
+                if not pend:
+                    return
+                t, node = pend[0], rng.choice(list(nodes.values()))
+                jobs[uid].update_task_status(t, TaskStatus.ALLOCATED)
+                node.add_task(t)
+                fc.feed_event("pod", "update", job=uid, node=node.name)
+            elif op == "acct":
+                name = rng.choice(list(nodes))
+                ni = nodes[name]
+                t = held.pop(name, None)
+                if t is not None:
+                    ni.remove_task(t)
+                    fc.feed_event("pod", "delete", job="ns/held",
+                                  node=name)
+                else:
+                    p = build_pod("ns", f"held-{name}-{rng.random()}",
+                                  name, "Running",
+                                  {"cpu": "2", "memory": "1Gi"}, "held")
+                    t = TaskInfo(p)
+                    t.status = TaskStatus.RUNNING
+                    ni.add_task(t)
+                    held[name] = t
+                    fc.feed_event("pod", "add", job="ns/held", node=name)
+            elif op == "minavail":
+                uid = rng.choice(list(jobs))
+                pg = jobs[uid].pod_group
+                pg.spec.min_member = 1 + rng.randrange(3)
+                jobs[uid].set_pod_group(pg)
+                fc.feed_event("podgroup", "update", job=uid)
+            elif op == "add_job":
+                k = next_job[0]
+                next_job[0] += 1
+                pg = build_pod_group(f"j{k}", "ns", min_member=2,
+                                     queue=f"q{k % 3}")
+                job = JobInfo(f"ns/j{k}", pg)
+                ts = []
+                for i in range(2):
+                    p = build_pod("ns", f"j{k}-{i}", "", "Pending",
+                                  {"cpu": "1", "memory": "1Gi"}, f"j{k}")
+                    t = TaskInfo(p)
+                    job.add_task_info(t)
+                    ts.append(t)
+                jobs[job.uid] = job
+                tasks_by_job[job.uid] = ts
+                fc.feed_event("pod", "add", job=job.uid)
+            elif op == "del_job":
+                if len(jobs) < 3:
+                    return
+                uid = rng.choice(list(jobs))
+                del jobs[uid]
+                fc.feed_event("pod", "delete", job=uid)
+            elif op == "drain":
+                # drain: running pods leave, then the node itself does
+                if len(nodes) < 3:
+                    return
+                name = rng.choice(list(nodes))
+                t = held.pop(name, None)
+                if t is not None:
+                    nodes[name].remove_task(t)
+                    fc.feed_event("pod", "delete", job="ns/held",
+                                  node=name)
+                del nodes[name]
+                fc.feed_event("node", "delete", node=name)
+            elif op == "spec":
+                name = rng.choice(list(nodes))
+                nodes[name].set_node(build_node(
+                    name, {"cpu": "32", "memory": "64Gi"},
+                    labels={"zone": f"z{rng.randrange(4)}"}))
+                fc.feed_event("node", "update", node=name)
+
+        for cycle in range(40):
+            for _ in range(rng.randrange(3)):
+                churn_once()
+            check()
+        # bucket resize: a burst of jobs crosses the T/J buckets
+        for _ in range(14):
+            next_job[0] += 1
+            k = next_job[0]
+            pg = build_pod_group(f"j{k}", "ns", min_member=3,
+                                 queue=f"q{k % 3}")
+            job = JobInfo(f"ns/j{k}", pg)
+            ts = []
+            for i in range(3):
+                p = build_pod("ns", f"j{k}-{i}", "", "Pending",
+                              {"cpu": "1", "memory": "1Gi"}, f"j{k}")
+                t = TaskInfo(p)
+                job.add_task_info(t)
+                ts.append(t)
+            jobs[job.uid] = job
+            tasks_by_job[job.uid] = ts
+            fc.feed_event("pod", "add", job=job.uid)
+        check()
+        check()
+        # the ladder must have exercised every rung across the matrix
+        assert "event" in modes and "cold" in modes, modes
+        assert any(m in ("incremental", "cold") for m in modes[2:]), modes
+
+    def test_dropped_event_falls_back_then_recovers(self):
+        from volcano_tpu.resilience.faultinject import faults
+
+        jobs, nodes, tasks_by_job, queues = self._build(6)
+        fc = self._fed_cache()
+
+        def check():
+            tasks = [t for u in jobs for t in tasks_by_job[u]
+                     if t.status == TaskStatus.PENDING]
+            self._assert_packed_identical(fc, jobs, nodes, tasks, queues)
+
+        check()
+        check()
+        assert fc.last_flatten_mode == "event"
+        try:
+            # drop exactly one delta on the feed's floor: a node-row
+            # accounting change the ledger never hears about
+            faults.arm_once("flatten_event")
+            ni = nodes["n1"]
+            p = build_pod("ns", "ghost", "n1", "Running",
+                          {"cpu": "4", "memory": "2Gi"}, "ghost")
+            t = TaskInfo(p)
+            t.status = TaskStatus.RUNNING
+            ni.add_task(t)
+            fc.feed_event("pod", "add", job="ns/ghost", node="n1")
+            check()  # byte-identity held BY THE FALLBACK, not the patch
+            assert fc.last_flatten_mode in ("incremental", "cold")
+            assert fc.last_fallback_reason == "epoch_mismatch"
+            check()  # ledger re-baselined: event mode resumes
+            assert fc.last_flatten_mode == "event"
+
+            # duplicated delivery skews the epoch the other way
+            faults.arm_once("flatten_event_dup")
+            ni.remove_task(t)
+            fc.feed_event("pod", "delete", job="ns/ghost", node="n1")
+            check()
+            assert fc.last_fallback_reason == "epoch_mismatch"
+            check()
+            assert fc.last_flatten_mode == "event"
+        finally:
+            faults.reset()
+
+
 class TestFusedDelta:
     """solve_allocate_delta (scatter fused into the solve dispatch) must
     match solve_allocate on the same snapshot, across churned sessions."""

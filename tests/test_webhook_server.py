@@ -14,6 +14,9 @@ from volcano_tpu.webhooks.server import from_wire, to_wire
 
 @pytest.fixture(scope="module")
 def server():
+    # self-signed cert bootstrap needs pyca/cryptography, which the
+    # runtime image may not carry — TLS coverage skips cleanly there
+    pytest.importorskip("cryptography")
     from volcano_tpu.models import Queue, QueueSpec
 
     cluster = ClusterStore()
@@ -107,6 +110,7 @@ class TestMutualTLS:
     presenting a cert signed by the CA drives admission normally."""
 
     def test_uncerted_client_rejected_certed_accepted(self, tmp_path):
+        pytest.importorskip("cryptography")
         from volcano_tpu.client import ClusterStore
         from volcano_tpu.models import Queue, QueueSpec
         from volcano_tpu.webhooks.server import generate_self_signed_cert

@@ -201,10 +201,32 @@ class AllocateAction(Action):
         if not tasks_in_order:
             return
 
+        fc = getattr(ssn, "flatten_cache", None)
+        if fc is not None and getattr(fc, "events_enabled", False) \
+                and getattr(ssn, "_mutation_ops", 0):
+            # an earlier action in this cycle already mutated the session's
+            # clones; those deltas never reached the event ledger, so the
+            # event-sourced fast path must re-diff this cycle
+            fc.suppress_event_path("session_mutations")
+        t_fs = _time.perf_counter()
         arr = flatten_snapshot(
             {j.uid: j for j, _ in job_order}, ssn.nodes, tasks_in_order,
-            queues=ssn.queues, cache=getattr(ssn, "flatten_cache", None),
-            grouped=job_order)
+            queues=ssn.queues, cache=fc, grouped=job_order)
+        fs_ms = (_time.perf_counter() - t_fs) * 1e3
+        if fc is not None:
+            # the event -> incremental -> cold ladder made observable:
+            # which assembly path this cycle's flatten took, how many rows
+            # it patched, and the patch-vs-full-pass latency split
+            timing["flatten_mode"] = fc.last_flatten_mode
+            timing["flatten_rows_patched"] = float(fc.last_rows_patched)
+            timing["flatten_events_applied"] = \
+                float(fc.last_events_applied)
+            if fc.last_flatten_mode == "event":
+                timing["flatten_patch_ms"] = fs_ms
+            else:
+                timing["flatten_full_ms"] = fs_ms
+            if fc.last_fallback_reason:
+                timing["flatten_fallback_reason"] = fc.last_fallback_reason
 
         # queue fairness: when proportion is active its session-open attrs
         # (allocated/request over ALL jobs, incl. running-only queues) feed

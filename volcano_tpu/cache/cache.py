@@ -72,6 +72,13 @@ class DefaultStatusUpdater:
         replaced = False
         for i, c in enumerate(pod.conditions):
             if c.get("type") == condition.get("type"):
+                if c == condition:
+                    # no-op rewrite: an unschedulable pod re-reported with
+                    # the SAME condition every cycle would otherwise churn
+                    # the store (and every mirror fed by it) per cycle —
+                    # exactly the noise that keeps a quiet cluster's
+                    # event-sourced flatten from being O(0)
+                    return
                 pod.conditions[i] = condition
                 replaced = True
         if not replaced:
@@ -279,10 +286,17 @@ class SchedulerCache:
         self._synced = False
 
         # incremental snapshot-flatten state shared across sessions
-        # (ops.arrays.FlattenCache; versions on JobInfo/NodeInfo invalidate)
+        # (ops.arrays.FlattenCache; versions on JobInfo/NodeInfo invalidate).
+        # The allocate cache runs EVENT-SOURCED: every watch delivery below
+        # forwards a typed delta (feed_event) as it arrives, and the
+        # version-gated snapshot-clone seam in _snapshot_locked re-marks
+        # whatever it re-cuts, so a scheduling cycle starts with the dirty
+        # rows already known and flatten_snapshot patches exactly those —
+        # host cost O(events since last cycle), ~zero on a quiet cluster
         from ..ops.arrays import FlattenCache
         from ..ops.device_cache import PackedDeviceCache
         self.flatten_cache = FlattenCache()
+        self.flatten_cache.enable_events()
         # separate caches for preempt/reclaim flattens: each action's task
         # set differs from allocate's AND from the other's, and sharing a
         # cache clobbers the wholesale fast-path key every cycle
@@ -394,7 +408,22 @@ class SchedulerCache:
 
     # -- watch dispatch -----------------------------------------------------
 
+    def _feed_flatten(self, kind, event, job=None, node=None):
+        """Forward one typed delta to the event-sourced flatten ledger
+        (no-op for embeddings that run without a flatten cache)."""
+        fc = self.flatten_cache
+        if fc is not None:
+            fc.feed_event(kind, event, job=job, node=node)
+
     def _on_pod(self, event, obj, old):
+        if obj.scheduler_name == self.scheduler_name:
+            key = job_key_of_pod(obj)
+            self._feed_flatten("pod", event, job=key,
+                               node=obj.node_name or None)
+            if old is not None and old.node_name \
+                    and old.node_name != obj.node_name:
+                self._feed_flatten("pod", event, job=key,
+                                   node=old.node_name)
         if event == "add":
             # resync-safe: a watch-resume (or re-list) can replay an add
             # for a pod this mirror already tracks; treating it as an
@@ -411,6 +440,13 @@ class SchedulerCache:
             self.delete_pod(obj)
 
     def _on_node(self, event, obj, old):
+        # an "add" for an already-known node is a respec in place (no
+        # position change); a genuinely new node relays the padded axis
+        ev = event
+        if event == "add" and obj.name in self.nodes \
+                and self.nodes[obj.name].node is not None:
+            ev = "update"
+        self._feed_flatten("node", ev, node=obj.name)
         if event == "add":
             self.add_node(obj)
         elif event == "update":
@@ -419,12 +455,15 @@ class SchedulerCache:
             self.delete_node(obj)
 
     def _on_podgroup(self, event, obj, old):
+        self._feed_flatten("podgroup", event,
+                           job=f"{obj.namespace}/{obj.name}")
         if event == "delete":
             self.delete_pod_group(obj)
         else:
             self.set_pod_group(obj)
 
     def _on_queue(self, event, obj, old):
+        self._feed_flatten("queue", event)
         if event == "delete":
             self.delete_queue(obj)
         else:
@@ -660,6 +699,13 @@ class SchedulerCache:
                     and prev.flat_epoch == ni.flat_epoch:
                 sn.nodes[name] = prev
                 continue
+            # version-gated clone seam doubles as the event feed's
+            # catch-all: ANY divergence since the last cycle (a watch
+            # delivery, a direct effector mutation, a session-mutated
+            # clone) forces a re-cut, and the re-cut marks the row dirty
+            # for the event-sourced flatten — so a delta the watch hooks
+            # never saw still lands in the ledger before the flatten runs
+            self._feed_flatten("node", "resync", node=name)
             clone = ni.clone()
             self._node_clone_cache[name] = clone
             sn.nodes[name] = clone
@@ -678,6 +724,10 @@ class SchedulerCache:
             # clone() copies the version and the global counter never
             # repeats, so one comparison covers both cache-side and
             # session-side mutation since the clone was cut
+            if prev is None or prev.flat_version != job.flat_version:
+                # re-cut ahead: mark the job dirty for the event-sourced
+                # flatten (same catch-all as the node seam above)
+                self._feed_flatten("job", "resync", job=key)
             if prev is not None and prev.flat_version == job.flat_version:
                 clone = prev
                 # per-session slates that don't bump the version; the

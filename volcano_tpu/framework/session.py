@@ -106,6 +106,14 @@ class Session:
         from ..ops.arrays import ScoreParams
         self.score_params = ScoreParams()
         self.solver_options: Dict[str, object] = {}
+        # session-side mutation odometer: bumped by every allocate/
+        # pipeline/evict applied to the session's clones (fire sites +
+        # statement records). The allocate action reads it before its
+        # flatten — a non-zero count means an earlier action mutated the
+        # flatten inputs OUTSIDE the event ledger's sight (e.g. a conf
+        # ordering preempt before allocate), so the event-sourced fast
+        # path must stand down for this cycle
+        self._mutation_ops = 0
         self.flatten_cache = getattr(cache, "flatten_cache", None)
         self.evict_flatten_caches = getattr(cache, "evict_flatten_caches",
                                             None) or {}
@@ -434,11 +442,13 @@ class Session:
         return n
 
     def _fire_allocate(self, task: TaskInfo) -> None:
+        self._mutation_ops += 1
         for eh in self.event_handlers:
             if eh.allocate_func is not None:
                 eh.allocate_func(Event(task))
 
     def _fire_deallocate(self, task: TaskInfo) -> None:
+        self._mutation_ops += 1
         for eh in self.event_handlers:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(task))
@@ -448,6 +458,7 @@ class Session:
         batch form get one call, others get the per-task loop."""
         if not tasks:
             return
+        self._mutation_ops += len(tasks)
         for eh in self.event_handlers:
             if eh.batch_allocate_func is not None:
                 eh.batch_allocate_func(tasks)

@@ -118,7 +118,9 @@ class Statement:
             node.add_task(task)
         if not self.defer_events:
             self.ssn._fire_allocate(task)
-        self.operations.append((Op.ALLOCATE, task, "")) 
+        else:
+            self.ssn._mutation_ops += 1
+        self.operations.append((Op.ALLOCATE, task, ""))
 
     def allocate_bulk(self, pairs) -> list:
         """allocate() over a whole assignment wave ([(task, hostname)]) in
@@ -224,6 +226,8 @@ class Statement:
             if not self.defer_events:
                 for task in tasks:
                     ssn._fire_allocate(task)
+            else:
+                ssn._mutation_ops += len(tasks)
             for task in tasks:
                 ops.append((Op.ALLOCATE, task, ""))
         for task, hostname in slow:

@@ -282,6 +282,38 @@ arena_shard_bytes_shipped = registry.register(Gauge(
     "(node-axis dirty chunks owned by the shard + its copy of the "
     "replicated task/job delta)", ["shard"]))
 
+# -- event-sourced flatten metrics (ops.arrays FlattenCache ledger) ---------
+
+flatten_cycles_total = registry.register(Counter(
+    "volcano_flatten_cycles_total",
+    "Scheduling-cycle flattens by assembly mode: event = ledger-driven "
+    "row patch (O(events)), incremental = prefix/suffix re-diff, cold = "
+    "full rebuild", ["mode"]))
+flatten_events_applied = registry.register(Gauge(
+    "volcano_flatten_events_applied",
+    "Mirror deltas consumed by the last flatten's event ledger (watch "
+    "deliveries + snapshot-seam re-cuts since the previous flatten)"))
+flatten_rows_patched = registry.register(Gauge(
+    "volcano_flatten_rows_patched",
+    "Padded buffer rows (task rows + node rows) patched in place by the "
+    "last event-mode flatten; 0 on a quiet cluster"))
+flatten_rows_patched_total = registry.register(Counter(
+    "volcano_flatten_rows_patched_total",
+    "Cumulative rows patched by event-mode flattens"))
+flatten_patch_ms = registry.register(Gauge(
+    "volcano_flatten_patch_milliseconds",
+    "Wall time of the last EVENT-mode flatten (validate epoch, patch "
+    "dirty rows, reuse the assembly)"))
+flatten_full_ms = registry.register(Gauge(
+    "volcano_flatten_full_milliseconds",
+    "Wall time of the last full-pass flatten (incremental re-diff or "
+    "cold rebuild)"))
+flatten_fallbacks_total = registry.register(Counter(
+    "volcano_flatten_fallbacks_total",
+    "Event-path declines into the full re-diff, by reason (epoch_"
+    "mismatch, node_relayout, job_layout, task_count, vocab_growth, "
+    "session_mutations, ...)", ["reason"]))
+
 # -- resilience metrics (resilience/, scheduler containment, store client) --
 
 breaker_state = registry.register(Gauge(

@@ -16,6 +16,7 @@ is tiny in practice even when T is 10k.
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -353,6 +354,142 @@ class FlattenCache:
         #: cached spec-keyed signature tuple (rebuilt only when some node's
         #: spec actually changed — accounting churn must not pay for it)
         self._spec_key: Optional[tuple] = None
+        # -- event-sourced flatten ledger (see enable_events) ---------------
+        self.events_enabled = False
+        self._ev_lock = threading.Lock()
+        self._ev_feed = 0          # deltas the owner OBSERVED (pre-drop)
+        self._ev_seq = 0           # deltas actually marked into the ledger
+        self._ev_prev_feed = 0     # both counters as of the last flatten
+        self._ev_prev_seq = 0
+        self._ev_dirty_jobs: set = set()
+        self._ev_dirty_nodes: set = set()
+        self._ev_node_relayout = False  # node add/delete/readiness change
+        self._ev_broken: Optional[str] = None  # unmapped delta seen
+        self._ev_valid = False     # support structures exist & trustworthy
+        self._evn: Optional[dict] = None  # event-path support structures
+        #: per-flatten observability, read by the allocate action/scheduler
+        self.last_flatten_mode = "cold"
+        self.last_fallback_reason: Optional[str] = None
+        self.last_rows_patched = 0
+        self.last_events_applied = 0
+        self.fallback_counts: Dict[str, int] = {}
+        self._count_base = 0
+
+    # -- event-sourced flatten ledger ---------------------------------------
+    #
+    # With events enabled, the owning mirror (SchedulerCache) forwards every
+    # typed delta it applies — pod add/update/delete, node events, job/
+    # podgroup events — via feed_event as it arrives, and the version-gated
+    # snapshot-clone seam re-marks anything it re-cuts. flatten_snapshot
+    # then starts from "the dirty rows ARE known" and patches exactly those
+    # onto the persistent padded buffers instead of re-diffing the whole
+    # snapshot: host cost O(events since last cycle), ~zero on a quiet
+    # cluster. Consistency epoch: _ev_feed counts deltas observed, _ev_seq
+    # deltas that actually landed in the ledger; a dropped or duplicated
+    # delivery skews them apart, the next flatten detects the skew and
+    # falls back to the full re-diff (which trusts nothing), so a broken
+    # feed degrades to the PR-1 incremental path, never to a wrong layout.
+
+    def enable_events(self) -> None:
+        """Opt this cache into the event-sourced flatten. The owner MUST
+        then feed every mirror delta through feed_event (directly or via
+        the snapshot-clone seam); unfed mutations void the byte-identity
+        guarantee of the event fast path."""
+        self.events_enabled = True
+
+    def feed_event(self, kind: str, event: str, job: Optional[str] = None,
+                   node: Optional[str] = None) -> None:
+        """Record one typed mirror delta. kind: pod|node|job|queue|resync;
+        ``job`` is the flatten job key (JobInfo.uid), ``node`` the node
+        name. Unknown kinds conservatively invalidate the ledger."""
+        if not self.events_enabled:
+            return
+        from ..resilience.faultinject import faults
+        with self._ev_lock:
+            self._ev_feed += 1
+        try:
+            # chaos seam: an armed `flatten_event` drops this delta on the
+            # floor exactly as a torn mirror feed would — the feed counter
+            # already moved, the ledger mark below never lands, and the
+            # epoch check catches the skew at the next flatten
+            faults.fire("flatten_event")
+        except Exception:  # noqa: BLE001 — the drop IS the fault
+            return
+        self._apply_mark(kind, event, job, node)
+        try:
+            # `flatten_event_dup`: the same delta delivered twice
+            faults.fire("flatten_event_dup")
+        except Exception:  # noqa: BLE001
+            self._apply_mark(kind, event, job, node)
+
+    def _apply_mark(self, kind: str, event: str, job: Optional[str],
+                    node: Optional[str]) -> None:
+        with self._ev_lock:
+            self._ev_seq += 1
+            if kind == "pod":
+                if job:
+                    self._ev_dirty_jobs.add(job)
+                if node:
+                    self._ev_dirty_nodes.add(node)
+            elif kind == "node":
+                if event in ("add", "delete"):
+                    # membership/position change: the padded node axis
+                    # relays out, which only the full diff handles
+                    self._ev_node_relayout = True
+                if node:
+                    self._ev_dirty_nodes.add(node)
+            elif kind in ("job", "podgroup"):
+                if job:
+                    self._ev_dirty_jobs.add(job)
+            elif kind == "queue":
+                pass  # queue tables rebuild from the queues dict per cycle
+            else:
+                self._ev_broken = f"unmapped:{kind}"
+
+    def suppress_event_path(self, reason: str) -> None:
+        """Decline the event fast path at the next flatten (the full
+        re-diff runs instead). For callers that mutated flatten inputs
+        outside the ledger's sight — e.g. a session whose conf ran
+        mutating actions before allocate."""
+        with self._ev_lock:
+            self._ev_broken = reason
+
+    def _ev_take(self) -> dict:
+        """Atomically snapshot the ledger at flatten start. Marks arriving
+        DURING the flatten belong to the next cycle and stay queued."""
+        with self._ev_lock:
+            return {
+                "feed": self._ev_feed, "seq": self._ev_seq,
+                "jobs": set(self._ev_dirty_jobs),
+                "nodes": set(self._ev_dirty_nodes),
+                "relayout": self._ev_node_relayout,
+                "broken": self._ev_broken,
+            }
+
+    def _ev_commit(self, taken: dict, mode: str,
+                   reason: Optional[str], rows_patched: int) -> None:
+        """Consume the taken ledger snapshot after a successful flatten of
+        EITHER path (the full re-diff revalidates everything, so its result
+        subsumes any marks it consumed) and re-baseline the epoch."""
+        with self._ev_lock:
+            self._ev_dirty_jobs -= taken["jobs"]
+            self._ev_dirty_nodes -= taken["nodes"]
+            if self._ev_feed == taken["feed"]:
+                # no concurrent marks: structural flags are fully consumed;
+                # otherwise leave them set so the next cycle re-diffs
+                self._ev_node_relayout = False
+                self._ev_broken = None
+            self._ev_prev_feed = taken["feed"]
+            self._ev_prev_seq = taken["seq"]
+            self._ev_valid = True
+        self.last_flatten_mode = mode
+        self.last_fallback_reason = reason
+        self.last_rows_patched = rows_patched
+        self.last_events_applied = taken["feed"] - self._count_base
+        self._count_base = taken["feed"]
+        if reason is not None:
+            self.fallback_counts[reason] = \
+                self.fallback_counts.get(reason, 0) + 1
 
     # -- per-node rows ------------------------------------------------------
 
@@ -507,12 +644,7 @@ def flatten_snapshot(
         cache.vocab = ResourceVocab.collect(resources)
     vocab = cache.vocab
 
-    # inline the ready check (state.phase is a slot read; the property call
-    # costs ~0.2us x N on the per-cycle floor)
-    _ready = NodePhase.READY
-    nodes_list = [n for n in nodes.values() if n.state.phase is _ready]
     n_tasks = len(tasks_in_order)
-    n_nodes = len(nodes_list)
 
     # group tasks by job, preserving order (callers that already hold the
     # per-job grouping — the allocate action — pass it via `grouped` and
@@ -547,6 +679,29 @@ def flatten_snapshot(
 
     if jobs_seq is None:
         jobs_seq = [jobs[k] for k in job_keys]
+
+    # -- event-sourced fast path --------------------------------------------
+    # With a fed ledger (cache.enable_events + feed_event) a cycle whose
+    # deltas all map onto existing rows skips EVERY per-job/per-node scan
+    # below: validate the consistency epoch, patch exactly the dirty rows,
+    # reuse the previous assembly. Anything structural (layout shift, node
+    # relayout, vocab growth, epoch skew) declines into the full re-diff
+    # below, which trusts nothing — the event -> incremental -> cold ladder.
+    taken = ev_reason = None
+    if cache.events_enabled:
+        taken = cache._ev_take()
+        arr, ev_reason = _flatten_event(
+            cache, jobs, nodes, tasks_in_order, queues,
+            job_keys, job_tasks, jobs_seq, taken)
+        if arr is not None:
+            return arr
+
+    # inline the ready check (state.phase is a slot read; the property call
+    # costs ~0.2us x N on the per-cycle floor)
+    _ready = NodePhase.READY
+    nodes_list = [n for n in nodes.values() if n.state.phase is _ready]
+    n_nodes = len(nodes_list)
+
     versions = [j.flat_version for j in jobs_seq]
     lens = [len(ts) for ts in job_tasks]
     nJ = len(job_keys)
@@ -656,6 +811,7 @@ def flatten_snapshot(
         oJ = 0
         off_P = 0
     if asm is not None:
+        flat_mode = "incremental"
         bufs = asm["bufs"]
         blocks_list = asm["blocks"]
         mid_blocks = []
@@ -708,17 +864,7 @@ def flatten_snapshot(
         old_mid_q = asm["job_queues"][P:oJ - S]
         asm["job_queues"][P:oJ - S] = new_queues
         if new_queues != old_mid_q:
-            queue_index = {}
-            queue_names = []
-            jq = bufs["job_queue"]
-            for j, q in enumerate(asm["job_queues"]):
-                qi = queue_index.get(q)
-                if qi is None:
-                    qi = queue_index[q] = len(queue_names)
-                    queue_names.append(q)
-                jq[j] = qi
-            asm["queue_index"] = queue_index
-            asm["queue_names"] = queue_names
+            _rebuild_queue_table(asm, bufs)
 
         # signature table: same first-seen-order argument — if the middle's
         # per-block signature sequence is unchanged, the global table (and
@@ -753,6 +899,7 @@ def flatten_snapshot(
         asm["n_tasks"] = n_tasks
     else:
         # cold / reshaped: full assembly into fresh persistent buffers
+        flat_mode = "cold"
         bufs = {
             "init": np.zeros((T, R), dtype=np.float32),
             "req": np.zeros((T, R), dtype=np.float32),
@@ -825,9 +972,17 @@ def flatten_snapshot(
     arr.job_ready_base = bufs["job_ready"]
     arr.job_queue = bufs["job_queue"]
     arr.job_valid = bufs["job_valid"]
-    return _finish(arr, cache, nodes_list, n_nodes, R, N, node_key, dirty,
-                   asm["sigs"], asm["sig_tasks"], asm["queue_index"],
-                   asm["queue_names"], queues)
+    arr = _finish(arr, cache, nodes_list, n_nodes, R, N, node_key, dirty,
+                  asm["sigs"], asm["sig_tasks"], asm["queue_index"],
+                  asm["queue_names"], queues)
+    cache.last_flatten_mode = flat_mode
+    if taken is not None:
+        # rebuild the event-path support structures against the fresh
+        # assembly, then consume the ledger snapshot: the full re-diff
+        # re-verified everything, so its marks are subsumed either way
+        _ev_refresh(cache, arr, nodes, nodes_list, job_keys, lens)
+        cache._ev_commit(taken, flat_mode, ev_reason, 0)
+    return arr
 
 
 def _rebuild_sigs(blocks_list, lens, sig_buf, n_tasks):
@@ -853,6 +1008,313 @@ def _rebuild_sigs(blocks_list, lens, sig_buf, n_tasks):
         off += k
     sig_buf[n_tasks:] = 0
     return sigs, sig_tasks
+
+
+def _rebuild_queue_table(asm, bufs) -> None:
+    """Queue index/name tables: global first-seen order over the per-job
+    queue sequence, job_queue rows rewritten in place. Runs only when some
+    rewritten block changed the queue sequence."""
+    queue_index: Dict[str, int] = {}
+    queue_names: List[str] = []
+    jq = bufs["job_queue"]
+    for j, q in enumerate(asm["job_queues"]):
+        qi = queue_index.get(q)
+        if qi is None:
+            qi = queue_index[q] = len(queue_names)
+            queue_names.append(q)
+        jq[j] = qi
+    asm["queue_index"] = queue_index
+    asm["queue_names"] = queue_names
+
+
+def _fill_sig_masks(cache, out, sigs, sig_tasks, nodes_list, spec_key,
+                    acct_key, N: int) -> None:
+    """Fill the [S, N] predicate mask rows from the per-signature row cache
+    (recomputing rows whose key moved). Shared by the full flatten and the
+    event path's refresh-after-node-churn."""
+    for s, s_idx in sigs.items():
+        # (even the unconstrained "" signature must run the node loop:
+        # untolerated NoSchedule taints block constraint-free pods too)
+        row_key = acct_key if sig_tasks[s_idx].pod.ports() else spec_key
+        cached = cache.sig_rows.get(s)
+        if cached is not None and cached[0] == row_key \
+                and cached[1].shape[0] == N:
+            out[s_idx] = cached[1]
+            continue
+        pod = sig_tasks[s_idx].pod
+        row = np.zeros(N, dtype=bool)
+        for n_idx, ni in enumerate(nodes_list):
+            node = ni.node
+            ok = True
+            if node is not None:
+                ok = (_match_node_selector(pod.node_selector or {}, node)
+                      and _tolerates(pod.tolerations, node)
+                      and _node_affinity_match(pod.affinity, node))
+                if ok and pod.ports():
+                    taken = set()
+                    for other in ni.tasks.values():
+                        taken.update(other.pod.ports())
+                    ok = not (set(pod.ports()) & taken)
+            row[n_idx] = ok
+        cache.sig_rows[s] = (row_key, row)
+        out[s_idx] = row
+
+
+def _apply_queue_overrides(arr, queue_index, queues, vocab) -> None:
+    """Overlay weight/capability from the session's queue objects onto the
+    default-initialized queue tables."""
+    if not queues:
+        return
+    for name, q_idx in queue_index.items():
+        qi = queues.get(name)
+        if qi is None:
+            continue
+        arr.queue_weight[q_idx] = getattr(qi, "weight", 1) or 1
+        cap = getattr(qi, "capability", None)
+        if cap:
+            cap_vec = Resource.from_resource_list(cap).to_vector(vocab)
+            arr.queue_capability[q_idx] = np.where(
+                cap_vec > 0, cap_vec, np.inf)
+
+
+def _ev_refresh(cache, arr, nodes, nodes_list, job_keys, lens) -> None:
+    """Rebuild the event path's support structures after a full flatten:
+    position maps, per-job buffer offsets, and references to the buffers
+    the finish-lite pass reuses. Only runs for event-enabled caches, so
+    plain caches pay nothing."""
+    asm = cache._asm
+    asm["job_pos"] = {k: i for i, k in enumerate(job_keys)}
+    offs = np.zeros(len(lens) + 1, dtype=np.int64)
+    if lens:
+        np.cumsum(np.asarray(lens, dtype=np.int64), out=offs[1:])
+    asm["offsets"] = offs
+    cache._evn = {
+        "arr": arr,
+        "nodes_list": nodes_list,
+        "node_pos": {ni.name: i for i, ni in enumerate(nodes_list)},
+        "n_total": len(nodes),
+        "N": cache._node_buf["N"],
+        "queue_bufs": (arr.queue_weight, arr.queue_capability,
+                       arr.queue_allocated, arr.queue_request),
+        "drf_alloc": arr.job_drf_allocated,
+        "drf_total": arr.drf_total,
+        "drf_prerank": arr.job_drf_prerank,
+    }
+
+
+def _flatten_event(cache, jobs, nodes, tasks_in_order, queues,
+                   job_keys, job_tasks, jobs_seq, taken):
+    """The event-sourced assembly: patch exactly the ledger-marked rows
+    onto the persistent padded buffers and reuse the previous SnapshotArrays
+    object. Returns (arr, None) on success or (None, reason) to decline
+    into the full re-diff. Byte-identity contract: given a completely fed
+    ledger, the returned buffers are bit-identical to a cold flatten of the
+    same inputs (tests/test_solver.py::TestFlattenEventIdentity)."""
+    asm = cache._asm
+    evn = cache._evn
+    if asm is None or evn is None or not cache._ev_valid:
+        return None, "no_assembly"
+    if taken["broken"]:
+        return None, taken["broken"]
+    if (taken["feed"] - cache._ev_prev_feed) \
+            != (taken["seq"] - cache._ev_prev_seq):
+        # the consistency epoch: a delta was observed but never marked (or
+        # marked twice) — the ledger cannot be trusted for this cycle
+        return None, "epoch_mismatch"
+    if taken["relayout"]:
+        return None, "node_relayout"
+    if len(nodes) != evn["n_total"]:
+        return None, "node_membership"
+    n_tasks = asm["n_tasks"]
+    if len(tasks_in_order) != n_tasks or n_tasks == 0:
+        return None, "task_count"
+    if job_keys != asm["job_keys"]:
+        # pending-set membership or job order shifted: block offsets move,
+        # which is the prefix/suffix diff's territory
+        return None, "job_layout"
+    vocab = cache.vocab
+    R, T, J = asm["shape"]
+    buf = cache._node_buf
+    if buf is None or buf["R"] != R or buf["N"] != evn["N"]:
+        return None, "node_buf"
+
+    lens = asm["lens"]
+    job_pos = asm["job_pos"]
+    dirty_jobs = []
+    for uid in taken["jobs"]:
+        j = job_pos.get(uid)
+        if j is None:
+            continue  # churned job not in this cycle's pending problem
+        if len(job_tasks[j]) != lens[j]:
+            return None, "task_count"
+        dirty_jobs.append(j)
+    nodes_list = evn["nodes_list"]
+    node_pos = evn["node_pos"]
+    _ready = NodePhase.READY
+    dirty_nodes = []
+    for name in taken["nodes"]:
+        i = node_pos.get(name)
+        ni = nodes.get(name)
+        if i is None:
+            if ni is not None and ni.state.phase is _ready:
+                # became schedulable without an add event reaching us
+                return None, "node_membership"
+            continue  # dirtied node not part of the padded problem
+        if ni is None or ni.state.phase is not _ready:
+            return None, "node_membership"
+        dirty_nodes.append((i, ni))
+
+    # vocab growth pre-pass over exactly the dirty entries; new resource
+    # names widen R, which re-lays out every padded buffer
+    for j in dirty_jobs:
+        ent = cache.job_blocks.get(job_keys[j])
+        if ent is None or ent["v"] != jobs_seq[j].flat_version:
+            cache.ensure_names(t.init_resreq for t in job_tasks[j])
+            cache.ensure_names(t.resreq for t in job_tasks[j])
+    for _, ni in dirty_nodes:
+        cache.ensure_names((ni.allocatable,))
+    if len(vocab) != R:
+        return None, "vocab_growth"
+
+    # -- patch dirty job blocks in place ------------------------------------
+    bufs = asm["bufs"]
+    offsets = asm["offsets"]
+    blocks_list = asm["blocks"]
+    rows_patched = 0
+    sig_rebuild = False
+    queue_rebuild = False
+    dirty_jobs.sort()
+    for j in dirty_jobs:
+        ts = job_tasks[j]
+        k = lens[j]
+        u = [t.uid for t in ts]
+        ent = cache.job_block(jobs_seq[j], ts, u)
+        off = int(offsets[j])
+        if k:
+            bufs["init"][off:off + k] = ent["init"]
+            bufs["req"][off:off + k] = ent["req"]
+            bufs["counts"][off:off + k] = ent["counts"]
+        rows_patched += k
+        bufs["job_min"][j] = ent["min"]
+        bufs["job_ready"][j] = ent["ready"]
+        blocks_list[j] = ent
+        asm["versions"][j] = jobs_seq[j].flat_version
+        asm["task_uids"][j] = u
+        if ent["queue"] != asm["job_queues"][j]:
+            asm["job_queues"][j] = ent["queue"]
+            queue_rebuild = True
+        if ent["sig_uniq"] != asm["block_sigs"][j]:
+            asm["block_sigs"][j] = ent["sig_uniq"]
+            sig_rebuild = True
+        elif k:
+            sigs_map = asm["sigs"]
+            uniq = ent["sig_uniq"]
+            if len(uniq) == 1:
+                bufs["sig"][off:off + k] = sigs_map[uniq[0]]
+            else:
+                remap = np.array([sigs_map[s] for s in uniq], np.int32)
+                bufs["sig"][off:off + k] = remap[ent["sig_local"]]
+    asm["task_lists"] = job_tasks
+    asm["job_keys"] = job_keys
+    if queue_rebuild:
+        _rebuild_queue_table(asm, bufs)
+    if sig_rebuild:
+        asm["sigs"], asm["sig_tasks"] = _rebuild_sigs(
+            blocks_list, lens, bufs["sig"], n_tasks)
+
+    # -- patch dirty node rows in place -------------------------------------
+    node_key = cache._node_key
+    rows = cache.node_rows
+    spec_stale = False
+    patched_nodes = 0
+    for i, ni in dirty_nodes:
+        if node_key[0][i] == ni.flat_epoch \
+                and node_key[1][i] == ni.flat_version:
+            if nodes_list[i] is not ni:
+                nodes_list[i] = ni  # re-cut clone with identical content
+            continue
+        if node_key[0][i] != ni.flat_epoch:
+            # same position, different NodeInfo identity without an
+            # add/delete event: don't guess, re-diff
+            return None, "node_epoch"
+        old = rows.get(ni.name)
+        if old is None or old["sv"] != ni.spec_version:
+            spec_stale = True
+        row = cache.node_row(ni)
+        buf["idle"][i] = row["idle"]
+        buf["extra"][i] = row["extra"]
+        buf["used"][i] = row["used"]
+        buf["alloc"][i] = row["alloc"]
+        buf["npods"][i] = row["npods"]
+        buf["maxp"][i] = row["maxp"]
+        node_key[1][i] = ni.flat_version
+        nodes_list[i] = ni
+        patched_nodes += 1
+        rows_patched += 1
+    if spec_stale:
+        cache._spec_key = tuple((ni.name, ni.flat_epoch, ni.spec_version)
+                                for ni in nodes_list)
+
+    # -- finish-lite: reassemble the previous SnapshotArrays ----------------
+    arr = evn["arr"]
+    N = evn["N"]
+    arr.vocab = vocab
+    arr.tasks_list = list(tasks_in_order)
+    arr.nodes_list = nodes_list
+    arr.jobs_list = jobs_seq
+    sigs = asm["sigs"]
+    sig_tasks = asm["sig_tasks"]
+    if sig_rebuild:
+        S = max(len(sigs), 1)
+        arr.sig_masks = np.zeros((S, N), dtype=bool)
+        if not sig_tasks:
+            arr.sig_masks[:, :] = True
+    if sig_rebuild or patched_nodes or spec_stale:
+        acct_key = (node_key[0].tobytes(), node_key[1].tobytes())
+        _fill_sig_masks(cache, arr.sig_masks, sigs, sig_tasks, nodes_list,
+                        cache._spec_key, acct_key, N)
+    # queue tables: weight/capability re-read from the session's queue
+    # objects every cycle (they are cheap and arrive as fresh clones);
+    # allocated/request re-zeroed because the allocate action overwrites
+    # them in place from the proportion plugin's attrs
+    queue_names = asm["queue_names"]
+    Q = bucket(max(len(queue_names), 1))
+    qw, qc, qa, qr = evn["queue_bufs"]
+    if qw.shape[0] != Q:
+        qw = np.zeros(Q, dtype=np.float32)
+        qc = np.full((Q, R), np.inf, dtype=np.float32)
+        qa = np.zeros((Q, R), dtype=np.float32)
+        qr = np.zeros((Q, R), dtype=np.float32)
+        evn["queue_bufs"] = (qw, qc, qa, qr)
+    else:
+        qw[:] = 0.0
+        qc[:] = np.inf
+        qa[:] = 0.0
+        qr[:] = 0.0
+    qw[:len(queue_names)] = 1.0
+    arr.queues_list = queue_names
+    arr.queue_weight = qw
+    arr.queue_capability = qc
+    arr.queue_allocated = qa
+    arr.queue_request = qr
+    _apply_queue_overrides(arr, asm["queue_index"], queues, vocab)
+    # DRF inputs: re-zeroed persistent buffers (the allocate action fills
+    # them in place when drf is active); hdrf arrays are rebuilt by
+    # build_hdrf per session, so reset to the fresh-flatten default
+    da, dt, dp = evn["drf_alloc"], evn["drf_total"], evn["drf_prerank"]
+    da[:] = 0.0
+    dt[:] = 0.0
+    dp[:] = 0
+    arr.job_drf_allocated = da
+    arr.drf_total = dt
+    arr.job_drf_prerank = dp
+    arr.hdrf_parent = arr.hdrf_weight = arr.hdrf_depth = None
+    arr.hdrf_is_leaf = arr.hdrf_leaf_req = arr.hdrf_job_leaf = None
+    arr.hdrf_ancestors = arr.hdrf_total_allocated = None
+    arr.thresholds = vocab.thresholds()
+    # scalar_dim_mask depends only on R, which is unchanged here
+    cache._ev_commit(taken, "event", None, rows_patched)
+    return arr, None
 
 
 def _bulk_node_rows(cache, fast, buf, R: int) -> None:
@@ -1010,34 +1472,9 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, node_key, dirty,
     # spec versions (the cached sk tuple); only port-aware masks key on the
     # full accounting state (epoch/version arrays serialized to bytes so
     # the cached-row compare is a memcmp, not 2k tuple compares)
-    spec_key = sk
     acct_key = (node_key[0].tobytes(), node_key[1].tobytes())
-    for s, s_idx in sigs.items():
-        # (even the unconstrained "" signature must run the node loop:
-        # untolerated NoSchedule taints block constraint-free pods too)
-        row_key = acct_key if sig_tasks[s_idx].pod.ports() else spec_key
-        cached = cache.sig_rows.get(s)
-        if cached is not None and cached[0] == row_key \
-                and cached[1].shape[0] == N:
-            arr.sig_masks[s_idx] = cached[1]
-            continue
-        pod = sig_tasks[s_idx].pod
-        row = np.zeros(N, dtype=bool)
-        for n_idx, ni in enumerate(nodes_list):
-            node = ni.node
-            ok = True
-            if node is not None:
-                ok = (_match_node_selector(pod.node_selector or {}, node)
-                      and _tolerates(pod.tolerations, node)
-                      and _node_affinity_match(pod.affinity, node))
-                if ok and pod.ports():
-                    taken = set()
-                    for other in ni.tasks.values():
-                        taken.update(other.pod.ports())
-                    ok = not (set(pod.ports()) & taken)
-            row[n_idx] = ok
-        cache.sig_rows[s] = (row_key, row)
-        arr.sig_masks[s_idx] = row
+    _fill_sig_masks(cache, arr.sig_masks, sigs, sig_tasks, nodes_list,
+                    sk, acct_key, N)
 
     # queues (water-filling inputs; overwritten by the allocate action from
     # the proportion plugin's session-open attrs when proportion is active —
@@ -1049,17 +1486,7 @@ def _finish(arr, cache, nodes_list, n_nodes, R, N, node_key, dirty,
     arr.queue_capability = np.full((Q, R), np.inf, dtype=np.float32)
     arr.queue_allocated = np.zeros((Q, R), dtype=np.float32)
     arr.queue_request = np.zeros((Q, R), dtype=np.float32)
-    if queues:
-        for name, q_idx in queue_index.items():
-            qi = queues.get(name)
-            if qi is None:
-                continue
-            arr.queue_weight[q_idx] = getattr(qi, "weight", 1) or 1
-            cap = getattr(qi, "capability", None)
-            if cap:
-                cap_vec = Resource.from_resource_list(cap).to_vector(vocab)
-                arr.queue_capability[q_idx] = np.where(
-                    cap_vec > 0, cap_vec, np.inf)
+    _apply_queue_overrides(arr, queue_index, queues, vocab)
 
     # DRF ordering inputs default to zeros (drf inactive -> static rank);
     # the allocate action overwrites them from the drf plugin's attrs

@@ -61,7 +61,15 @@ per routed mutation, and once per touched shard inside a bulk wave —
 arm ``exc:exit`` in a sharded store process to SIGKILL it with some
 shards' sub-batches durable and others not, so recovery must heal every
 per-shard WAL lineage; for killing ONE shard in-process, see
-ShardedClusterStore.crash_shard/recover_shard).
+ShardedClusterStore.crash_shard/recover_shard), ``flatten_event``
+(ops/arrays FlattenCache.feed_event, between observing a mirror delta
+and marking it into the event-sourced flatten ledger — an armed firing
+DROPS the delta exactly as a torn feed would: the observation counter
+moved, the mark never landed, and the next flatten's consistency-epoch
+check detects the skew and falls back to the full re-diff instead of
+assembling from a stale layout), and ``flatten_event_dup`` (same seam,
+after the mark — an armed firing applies the delta a second time,
+skewing the epoch the other way; detection and fallback are identical).
 """
 
 from __future__ import annotations

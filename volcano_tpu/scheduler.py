@@ -282,6 +282,32 @@ class Scheduler:
             if key in timing:
                 metrics.session_phase_ms.set(
                     timing[key], labels={"phase": key[:-3]})
+        # event-sourced flatten accounting (ops.arrays FlattenCache
+        # ledger): which assembly path this cycle took, how many rows the
+        # event patch touched, the patch-vs-full latency split, and the
+        # fallback ladder's reason counters — exported alongside the
+        # per-phase gauges because a cycle silently degrading from
+        # O(events) to O(cluster) is exactly the regression these exist
+        # to catch
+        fc = getattr(self.cache, "flatten_cache", None)
+        if fc is not None and getattr(fc, "events_enabled", False) \
+                and "flatten_mode" in timing:
+            mode = timing["flatten_mode"]
+            metrics.flatten_cycles_total.inc(labels={"mode": mode})
+            metrics.flatten_events_applied.set(
+                timing.get("flatten_events_applied", 0.0))
+            rows = timing.get("flatten_rows_patched", 0.0)
+            metrics.flatten_rows_patched.set(rows)
+            if rows:
+                metrics.flatten_rows_patched_total.inc(rows)
+            if "flatten_patch_ms" in timing:
+                metrics.flatten_patch_ms.set(timing["flatten_patch_ms"])
+            if "flatten_full_ms" in timing:
+                metrics.flatten_full_ms.set(timing["flatten_full_ms"])
+            reason = timing.get("flatten_fallback_reason")
+            if reason:
+                metrics.flatten_fallbacks_total.inc(
+                    labels={"reason": str(reason)})
         from .ops.precompile import watcher
         c, s = watcher.session_totals()
         prev_c, prev_s = self._compile_totals
