@@ -1,0 +1,54 @@
+"""Subprocess entry for the replica kill-9 tests and the
+``read_replica_fanout`` bench: ONE ReplicaStore following a primary,
+serving reads on a fixed port, nothing else. The driver SIGKILLs this
+process mid-churn and starts a fresh one on the same port; the fresh
+replica re-bootstraps from the primary's newest snapshot and re-tails —
+watchers attached to the replica resume through the normal ``since:``
+path against its rebuilt journal, and the final mirror must be
+bind-for-bind identical to the primary (and to a never-killed golden).
+
+Usage: python replica_proc.py --primary HOST:PORT --port P
+       [--faults SPEC]
+
+Prints ``READY <port> applied=<rv>`` once serving (the driver waits for
+it), then sleeps until killed. Imports stay store-only — no jax, no
+scheduler — so a restart is fast."""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--primary", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--faults", default=None)
+    args = ap.parse_args()
+
+    from volcano_tpu.client import ReplicaStore
+    from volcano_tpu.resilience import faults
+
+    if args.faults:
+        faults.configure(args.faults)
+
+    replica = ReplicaStore(args.primary)
+    server = replica.serve(port=args.port)
+    replica.start()
+    print(f"READY {server.port} applied={replica.applied_rv()}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    replica.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,77 @@
+"""Read-tier load generator for the ``read_replica_fanout`` bench: N
+watch streams + M list-storm threads against one store endpoint
+(primary or replica), in THEIR OWN process so the fan-out cost never
+shares the driver's (or the server's) GIL — the same
+separate-processes-are-the-point rule as store_churn_proc.py.
+
+Prints ``READY`` once every watch stream is subscribed, waits for
+``GO`` on stdin, storms until ``STOP`` arrives (list threads loop,
+watchers count deliveries), then prints
+``DONE <events_seen> <lists_done> <list_errors>``."""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--watchers", type=int, default=100)
+    ap.add_argument("--list-threads", type=int, default=2)
+    ap.add_argument("--namespace", default="churn")
+    args = ap.parse_args()
+
+    from volcano_tpu.client import RemoteClusterStore
+
+    client = RemoteClusterStore(args.addr, connect_timeout=10.0)
+    seen = [0]
+    lock = threading.Lock()
+
+    def on_pod(event, obj, old):
+        with lock:
+            seen[0] += 1
+
+    for _ in range(args.watchers):
+        client.watch("pods", on_pod, replay=False)
+    print("READY", flush=True)
+    if sys.stdin.readline().strip() != "GO":
+        return 1
+
+    stop = threading.Event()
+    lists = [0]
+    list_errors = [0]
+
+    def list_storm():
+        lister = RemoteClusterStore(args.addr, connect_timeout=10.0)
+        while not stop.is_set():
+            try:
+                lister.list("pods", namespace=args.namespace)
+                with lock:
+                    lists[0] += 1
+            except Exception:  # noqa: BLE001 — counted, not fatal
+                with lock:
+                    list_errors[0] += 1
+                time.sleep(0.05)
+        lister.close()
+
+    threads = [threading.Thread(target=list_storm, daemon=True)
+               for _ in range(args.list_threads)]
+    for t in threads:
+        t.start()
+    sys.stdin.readline()  # STOP (or EOF)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    client.close()
+    print(f"DONE {seen[0]} {lists[0]} {list_errors[0]}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

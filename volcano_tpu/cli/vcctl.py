@@ -31,6 +31,25 @@ def _fmt_age(ts: float) -> str:
     return f"{s // 3600}h"
 
 
+def _list_versioned(cluster, args, kind: str, **kw):
+    """List with staleness surfaced: ``(objects, applied_rv)``. Against
+    a remote store (primary or replica) the response's ``applied_rv``
+    comes back for display and ``--min-rv`` rides through as the
+    rv-bounded read (a replica blocks-or-fails until it has applied that
+    rv); the in-process store is its own source of truth, so there is
+    nothing to bound or report."""
+    lv = getattr(cluster, "list_versioned", None)
+    if lv is not None:
+        return lv(kind, min_rv=getattr(args, "min_rv", None), **kw)
+    return cluster.list(kind, **kw), None
+
+
+def _rv_footer(applied_rv) -> str:
+    if applied_rv is None:
+        return ""
+    return f"\napplied_rv: {applied_rv}"
+
+
 def _table(headers: List[str], rows: List[List[str]]) -> str:
     widths = [len(h) for h in headers]
     for row in rows:
@@ -127,7 +146,8 @@ def _job_from_yaml(raw: dict) -> Job:
 
 
 def job_list(args, cluster: ClusterStore) -> str:
-    jobs = cluster.list("jobs", namespace=args.namespace)
+    jobs, applied_rv = _list_versioned(cluster, args, "jobs",
+                                       namespace=args.namespace)
     rows = []
     for j in sorted(jobs, key=lambda x: x.name):
         st = j.status
@@ -137,7 +157,8 @@ def job_list(args, cluster: ClusterStore) -> str:
                      st.state.phase.value, str(st.pending), str(st.running),
                      str(st.succeeded), str(st.failed), str(st.retry_count)])
     return _table(["Name", "Age", "Replicas", "Min", "Phase", "Pending",
-                   "Running", "Succeeded", "Failed", "RetryCount"], rows)
+                   "Running", "Succeeded", "Failed", "RetryCount"],
+                  rows) + _rv_footer(applied_rv)
 
 
 def job_view(args, cluster: ClusterStore) -> str:
@@ -248,13 +269,14 @@ def queue_create(args, cluster: ClusterStore) -> str:
 
 
 def queue_list(args, cluster: ClusterStore) -> str:
+    queues, applied_rv = _list_versioned(cluster, args, "queues")
     rows = []
-    for q in sorted(cluster.list("queues"), key=lambda x: x.name):
+    for q in sorted(queues, key=lambda x: x.name):
         rows.append([q.name, str(q.spec.weight), q.status.state.value,
                      str(q.status.inqueue), str(q.status.pending),
                      str(q.status.running), str(q.status.unknown)])
     return _table(["Name", "Weight", "State", "Inqueue", "Pending",
-                   "Running", "Unknown"], rows)
+                   "Running", "Unknown"], rows) + _rv_footer(applied_rv)
 
 
 def queue_get(args, cluster: ClusterStore) -> str:
@@ -386,6 +408,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drive a deployed control plane over TCP "
                         "(standalone --serve-store) instead of an "
                         "in-process store")
+    p.add_argument("--replica", default=None, metavar="HOST:PORT",
+                   help="route READ commands (job list/view, queue "
+                        "list/get) to a read replica (standalone "
+                        "--serve-replica) instead of the primary; "
+                        "output then reports the replica's applied_rv "
+                        "so staleness is visible at a glance. Writes "
+                        "still go to --server (a replica refuses them)")
+    p.add_argument("--min-rv", type=int, default=None, dest="min_rv",
+                   metavar="RV",
+                   help="rv-bounded read: block until the (replica) "
+                        "store has applied this resource_version, fail "
+                        "typed if it cannot within the wait budget — "
+                        "read-your-writes against an explicitly stale "
+                        "read tier")
     p.add_argument("--token", default=None,
                    help="store auth token (default $VOLCANO_STORE_TOKEN)")
     p.add_argument("--tls-ca", default=None, metavar="PEM",
@@ -501,17 +537,32 @@ ALIASES = {
 }
 
 
+#: (group, verb) pairs safe to serve from a read replica
+_READ_VERBS = {("job", "list"), ("job", "view"),
+               ("queue", "list"), ("queue", "get")}
+
+
 def main(argv: List[str], cluster: Optional[ClusterStore] = None) -> str:
     if argv and argv[0] in ALIASES:
         argv = ALIASES[argv[0]] + argv[1:]
     args = build_parser().parse_args(argv)
+    verb = getattr(args, "verb", None)
     if cluster is None:
-        if args.server:
+        if args.replica and (args.group, verb) in _READ_VERBS:
+            # the read tier: same wire protocol, explicit staleness
+            from ..client.remote import RemoteClusterStore
+            cluster = RemoteClusterStore(args.replica, token=args.token,
+                                         tls_ca=args.tls_ca)
+        elif args.server:
             # the wire path of cmd/cli/vcctl.go:44-49 (kubeconfig -> API
             # server); here HOST:PORT -> standalone's StoreServer
             from ..client.remote import RemoteClusterStore
             cluster = RemoteClusterStore(args.server, token=args.token,
                                          tls_ca=args.tls_ca)
+        elif args.replica and args.group not in (None, "version", "sim"):
+            raise SystemExit(
+                f"vcctl {args.group} {verb or ''} mutates the cluster; "
+                "a replica is read-only — point --server at the primary")
         elif args.token or args.tls_ca:
             # succeeding against a throwaway in-process store while the
             # user thinks they reached a deployed control plane is a trap
@@ -521,7 +572,7 @@ def main(argv: List[str], cluster: Optional[ClusterStore] = None) -> str:
             cluster = ClusterStore()
     if args.group == "version":
         return f"vcctl version {__version__}"
-    fn = _DISPATCH.get((args.group, getattr(args, "verb", None)))
+    fn = _DISPATCH.get((args.group, verb))
     if fn is None:
         return build_parser().format_help()
     return fn(args, cluster)

@@ -1,14 +1,19 @@
 """Cluster store: the API-server/informer seam (in-memory + over TCP),
-plus the optional WAL/snapshot durability layer behind it and the
-sharded front door (partitioned store + one-endpoint router)."""
+plus the optional WAL/snapshot durability layer behind it, the sharded
+front door (partitioned store + one-endpoint router), and the
+WAL-shipped read-replica tier."""
 
 from .durable import DurableClusterStore, WriteAheadLog  # noqa: F401
 from .remote import RemoteClusterStore  # noqa: F401
+from .replica import (  # noqa: F401
+    ReplicaGapError, ReplicaServer, ReplicaStore, ShardedReplicaServer,
+)
 from .server import StoreServer  # noqa: F401
 from .sharded import (  # noqa: F401
     ShardedClusterStore, ShardRouter, shard_for,
 )
 from .store import (  # noqa: F401
     AdmissionError, ClusterStore, ConflictError, FencedError, FencedStore,
-    NotFoundError, ResumeGapError, ShardUnavailableError,
+    NotFoundError, ReplicaLagError, ReplicaReadOnlyError, ResumeGapError,
+    ShardUnavailableError,
 )

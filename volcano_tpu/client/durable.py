@@ -276,6 +276,11 @@ class DurableClusterStore(ClusterStore):
         self._batch_depth = 0
         self._records_since_snapshot = 0
         self._wal: Optional[WriteAheadLog] = None  # None during recovery
+        #: WAL-shipping hooks (client/replica.py): called with each
+        #: committed record dict, under the store lock, AFTER the append
+        #: — a ship stream's live tail sees exactly the records the WAL
+        #: holds, in commit order
+        self._ship_listeners: list = []
         self._recover()
         self._wal = self._open_segment()
         try:
@@ -410,8 +415,10 @@ class DurableClusterStore(ClusterStore):
         # a write that a crash could still lose
         if self._wal is not None:
             t0 = time.perf_counter()
+            # ts: commit wall time, so a replica tailing shipped records
+            # can report lag in SECONDS, not just records
             rec = {"rv": self._rv, "kind": kind, "event": event,
-                   "obj": encode(obj)}
+                   "obj": encode(obj), "ts": round(time.time(), 3)}
             if self._fence_ctx:
                 rec["fencing"] = self._fence_ctx
             self._wal.append(rec, sync=self._batch_depth == 0)
@@ -426,6 +433,8 @@ class DurableClusterStore(ClusterStore):
             except Exception:  # noqa: BLE001
                 pass
             faults.fire("store_crash")
+            for fn in list(self._ship_listeners):
+                fn(rec)
             self._records_since_snapshot += 1
             if self._records_since_snapshot >= self.snapshot_every \
                     and self._batch_depth == 0:
@@ -507,6 +516,43 @@ class DurableClusterStore(ClusterStore):
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
+
+    # -- WAL shipping (read replicas, client/replica.py) --------------------
+
+    def add_ship_listener(self, fn) -> None:
+        """Subscribe to committed WAL records (called under the store
+        lock with the record dict, after the append). The ship stream's
+        live-tail seam."""
+        with self._lock:
+            self._ship_listeners.append(fn)
+
+    def remove_ship_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._ship_listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def ship_floor(self) -> int:
+        """Oldest rv a ship stream can resume AFTER: records at rv <=
+        this are no longer in retained WAL segments (pruned into
+        snapshots), so a replica whose applied rv fell below it has a
+        HOLE it must close with a fresh snapshot bootstrap, never by
+        skipping. Call under the store lock to pair it with ``_rv``."""
+        segments = _segment_paths(self.data_dir)
+        return _start_rv(segments[0]) if segments else self._rv
+
+    def newest_snapshot_state(self) -> Tuple[int, Optional[dict]]:
+        """The newest VALID on-disk snapshot as ``(rv, state)`` — the
+        replica bootstrap payload. A corrupt newest snapshot falls back
+        to the previous (same rule recovery applies); no valid snapshot
+        means ``(0, None)``: the replica starts empty and the WAL (still
+        fully retained — pruning requires snapshots) replays history."""
+        for path in reversed(_snapshot_paths(self.data_dir)):
+            state = load_snapshot(path)
+            if state is not None:
+                return int(state["rv"]), state
+        return 0, None
 
     # -- introspection ------------------------------------------------------
 

@@ -129,6 +129,15 @@ class RemoteClusterStore:
         self.watch_backoff_cap_s = watch_backoff_cap_s
         self.watch_resumes = 0   # successful in-place stream resumes
         self._lock = threading.RLock()   # local mirror/listener lock
+        # per-kind {shard: rv} high-water marks across ALL of this
+        # client's watch streams — the causal floor a (possibly retried)
+        # list response must not fall behind, and the catch-up target
+        # wait_stream_applied blocks on
+        self._kind_hwm: Dict[str, Dict[str, int]] = {}
+        self._hwm_cv = threading.Condition(self._lock)
+        #: applied_rv of the most recent list response (staleness at a
+        #: glance for CLIs/dashboards)
+        self.last_list_applied_rv = None
         # request-connection pool: idle sockets ready for checkout, a
         # live count capping concurrency at pool_size, and the full set
         # so close() can unblock an in-flight recv
@@ -223,7 +232,8 @@ class RemoteClusterStore:
         # instead of synchronizing. Connections come from a pool of
         # pool_size (default 1 — the historical one-socket serialization).
         op = payload.get("op")
-        idempotent = op in ("get", "list", "ping")
+        idempotent = op in ("get", "list", "ping", "store_info",
+                            "bootstrap")
         conditional = op in ("create", "delete") or (
             op in ("update", "apply")
             and bool(((payload.get("obj") or {}).get("f") or {})
@@ -392,11 +402,104 @@ class RemoteClusterStore:
 
     def list(self, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None,
-             name_glob: Optional[str] = None) -> List[Any]:
-        resp = self._request(
-            {"op": "list", "kind": kind, "namespace": namespace,
-             "label_selector": label_selector, "name_glob": name_glob})
-        return [decode(o) for o in resp["objs"]]
+             name_glob: Optional[str] = None, min_rv=None,
+             wait_s: Optional[float] = None) -> List[Any]:
+        return self.list_versioned(kind, namespace, label_selector,
+                                   name_glob, min_rv=min_rv,
+                                   wait_s=wait_s)[0]
+
+    def list_versioned(self, kind: str, namespace: Optional[str] = None,
+                       label_selector: Optional[Dict[str, str]] = None,
+                       name_glob: Optional[str] = None, min_rv=None,
+                       wait_s: Optional[float] = None):
+        """``list`` with its staleness made explicit: returns
+        ``(objects, applied_rv)`` where ``applied_rv`` is the exact
+        store version the response reflects (scalar, or ``{shard: rv}``
+        against a sharded endpoint; None from a pre-applied_rv server).
+
+        ``min_rv=`` is the rv-bounded read against a replica: the
+        replica blocks until it has applied that rv or fails typed with
+        ReplicaLagError after ``wait_s`` (the primary satisfies any rv
+        it ever minted, trivially).
+
+        Closing the retried-list hole: list is retried as idempotent,
+        so a retry after an unacked response can land on a view that
+        DISAGREES with what this client's own watch streams already
+        delivered — most sharply, a view BEHIND the stream's rv
+        high-water mark (a restarted primary that recovered short of
+        its unfsynced tail, or a replica that just re-bootstrapped from
+        an older snapshot). Acting on that response would regress a
+        mirror the way a blind write replay used to double-apply, so a
+        response behind the stream hwm is DISCARDED and re-requested;
+        if the server stays behind, ReplicaLagError surfaces instead of
+        stale data. (For the other direction — a list AHEAD of the
+        stream — see wait_stream_applied.)"""
+        from .store import ReplicaLagError
+        payload = {"op": "list", "kind": kind, "namespace": namespace,
+                   "label_selector": label_selector,
+                   "name_glob": name_glob}
+        if min_rv is not None:
+            payload["min_rv"] = min_rv
+            if wait_s is not None:
+                payload["wait_s"] = wait_s
+        applied = None
+        resp = None
+        for attempt in range(self.retry_attempts + 1):
+            resp = self._request(payload)
+            applied = resp.get("applied_rv")
+            if not self._behind_stream(kind, applied):
+                break
+            if attempt >= self.retry_attempts:
+                raise ReplicaLagError(
+                    f"list({kind!r}) response at applied_rv {applied} is "
+                    f"behind this client's watch high-water mark "
+                    f"{self._kind_hwm.get(kind)}; refusing to serve a "
+                    "view older than the stream already delivered")
+            self._stop_event.wait(0.05 * (attempt + 1))
+        with self._lock:
+            self.last_list_applied_rv = applied
+        return [decode(o) for o in resp["objs"]], applied
+
+    def _behind_stream(self, kind: str, applied) -> bool:
+        """True when a list response's applied_rv predates an event this
+        client's watch streams already delivered for ``kind``."""
+        if applied is None:
+            return False
+        with self._lock:
+            hk = self._kind_hwm.get(kind)
+            if not hk:
+                return False
+            if isinstance(applied, dict):
+                return any(int(applied.get(sh, -1)) < rv
+                           for sh, rv in hk.items())
+            return int(applied) < hk.get("0", -1)
+
+    def _stream_covers(self, kind: str, applied) -> bool:
+        # caller holds self._lock
+        hk = self._kind_hwm.get(kind, {})
+        if isinstance(applied, dict):
+            return all(hk.get(str(sh), -1) >= int(rv)
+                       for sh, rv in applied.items())
+        return hk.get("0", -1) >= int(applied)
+
+    def wait_stream_applied(self, kind: str, applied_rv,
+                            timeout: float = 5.0) -> bool:
+        """Block until this client's watch stream(s) for ``kind`` have
+        delivered events up to ``applied_rv`` (a list response's stamp)
+        — the complement of the stale-list discard: a list AHEAD of the
+        stream must not drive a mirror until the stream has caught up,
+        or events older than the list would regress it. Returns False on
+        timeout (e.g. no stream is watching the kind)."""
+        if applied_rv is None:
+            return True
+        deadline = time.monotonic() + timeout
+        with self._hwm_cv:
+            while not self._stream_covers(kind, applied_rv):
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed:
+                    return False
+                self._hwm_cv.wait(min(left, 0.5))
+        return True
 
     def ping(self) -> bool:
         return bool(self._request({"op": "ping"}).get("ok"))
@@ -488,6 +591,13 @@ class RemoteClusterStore:
         t.start()
         self._watch_threads.append(t)
 
+    def _fold_hwm(self, kind: str, sh: str, rv: int) -> None:
+        # caller holds self._lock; the shared cross-stream floor only
+        # ever advances (streams may individually resume from behind it)
+        hk = self._kind_hwm.setdefault(kind, {})
+        if int(rv) > hk.get(str(sh), -1):
+            hk[str(sh)] = int(rv)
+
     @staticmethod
     def _advance_hwm(state: dict, kind: str, val) -> None:
         """Fold a synced-frame rv value — the legacy scalar, or the
@@ -521,6 +631,9 @@ class RemoteClusterStore:
                     for kind in subs:
                         if kind in rvmap:
                             self._advance_hwm(state, kind, rvmap[kind])
+                            for sh, rv in state["hwm"][kind].items():
+                                self._fold_hwm(kind, sh, rv)
+                    self._hwm_cv.notify_all()
                 if until_synced:
                     return
                 continue
@@ -553,6 +666,8 @@ class RemoteClusterStore:
                         hk = state["hwm"].setdefault(kind, {})
                         sh = str(shard) if shard is not None else "0"
                         hk[sh] = max(hk.get(sh, -1), int(rv))
+                        self._fold_hwm(kind, sh, hk[sh])
+                self._hwm_cv.notify_all()
 
     def _resume_watch(self, subs: Dict[str, List], op: str, state: dict,
                       desc: str):

@@ -38,10 +38,12 @@ from typing import Dict, Optional
 
 import hmac
 
+from ..resilience.faultinject import faults
 from .codec import decode, encode
 from .store import (
     KINDS, AdmissionError, ClusterStore, ConflictError, FencedError,
-    NotFoundError, ResumeGapError, ShardUnavailableError,
+    NotFoundError, ReplicaLagError, ReplicaReadOnlyError, ResumeGapError,
+    ShardUnavailableError,
 )
 
 log = logging.getLogger(__name__)
@@ -53,6 +55,7 @@ WATCH_SEND_TIMEOUT_S = 30.0
 TLS_HANDSHAKE_TIMEOUT_S = 10.0
 JOURNAL_CAPACITY = 4096     # per-kind resume window (events)
 WATCH_BATCH_MAX = 256       # events coalesced per bulk_watch frame
+SHIP_BATCH_MAX = 256        # WAL records coalesced per ship frame
 
 _ERRORS = {
     "ConflictError": ConflictError,
@@ -61,7 +64,44 @@ _ERRORS = {
     "ResumeGapError": ResumeGapError,
     "FencedError": FencedError,
     "ShardUnavailableError": ShardUnavailableError,
+    "ReplicaReadOnlyError": ReplicaReadOnlyError,
+    "ReplicaLagError": ReplicaLagError,
 }
+
+
+def applied_rv_of(store) -> object:
+    """The store's committed resource_version(s) for response stamping:
+    the global rv scalar, or — sharded — the ``{shard: rv}`` map (each
+    shard owns its own sequence). Call under ``store.locked()`` so the
+    stamp is consistent with the reads it rides alongside."""
+    shards = getattr(store, "shards", None)
+    if shards is not None:
+        return {str(i): s._rv for i, s in enumerate(shards)}
+    return store._rv
+
+
+def _ship_source(store, shard) -> "ClusterStore":
+    """Resolve a ship/bootstrap request to the durable store that owns
+    the WAL lineage: the store itself, or — behind a ShardRouter — the
+    requested member shard. Refuses non-durable stores: a replica can
+    only follow a primary with segments to ship (and a replica's own
+    backing store is never durable, so chained replicas refuse here)."""
+    shards = getattr(store, "shards", None)
+    idx = int(shard or 0)
+    if shards is None:
+        if idx != 0:
+            raise RuntimeError(f"unsharded store has no shard {idx}")
+        target = store
+    else:
+        if not 0 <= idx < len(shards):
+            raise RuntimeError(
+                f"shard {idx} out of range (store has {len(shards)})")
+        target = store._shard(idx)  # ShardUnavailableError when down
+    if getattr(target, "data_dir", None) is None:
+        raise RuntimeError(
+            "replica bootstrap/ship requires a durable primary "
+            "(--store-data-dir): an in-memory store has no WAL to ship")
+    return target
 
 
 class EventJournal:
@@ -307,10 +347,16 @@ class _Handler(socketserver.BaseRequestHandler):
                 if op in ("watch", "bulk_watch"):
                     self._serve_watch(sock, store, req)
                     return  # watch connections never go back to req/resp
+                if op == "ship":
+                    # WAL shipping (read replicas): the connection
+                    # becomes a one-way record stream, like watch
+                    self._serve_ship(sock, store, req)
+                    return
                 try:
                     resp = self._dispatch(store, op, req)
                 except (ConflictError, NotFoundError, AdmissionError,
-                        ShardUnavailableError) as e:
+                        ShardUnavailableError, ReplicaReadOnlyError,
+                        ReplicaLagError) as e:
                     resp = {"ok": False, "error": type(e).__name__,
                             "message": str(e)}
                 except ConnectionError:
@@ -376,13 +422,41 @@ class _Handler(socketserver.BaseRequestHandler):
                     out.append({"obj": encode(res)})
             return {"ok": True, "results": out}
         if op == "get":
-            obj = store.get(kind, req["name"], req.get("namespace"))
-            return {"ok": True, "obj": encode(obj)}
+            with store.locked():
+                rv = applied_rv_of(store)
+                obj = store.get(kind, req["name"], req.get("namespace"))
+            return {"ok": True, "obj": encode(obj), "applied_rv": rv}
         if op == "list":
-            objs = store.list(kind, req.get("namespace"),
-                              req.get("label_selector"),
-                              req.get("name_glob"))
-            return {"ok": True, "objs": [encode(o) for o in objs]}
+            # rv stamped under the SAME lock hold as the read, so the
+            # response names the exact store version it reflects — a
+            # mirror can order a (possibly retried) list against the rv
+            # high-water mark of its concurrent watch stream. min_rv on
+            # the authoritative store is trivially satisfied: every rv
+            # a client can legally hold was minted here. (A replica's
+            # handler overrides this with real rv-bounded blocking.)
+            with store.locked():
+                rv = applied_rv_of(store)
+                objs = store.list(kind, req.get("namespace"),
+                                  req.get("label_selector"),
+                                  req.get("name_glob"))
+            return {"ok": True, "objs": [encode(o) for o in objs],
+                    "applied_rv": rv}
+        if op == "store_info":
+            # replica handshake: shape + current rv(s) + whether a WAL
+            # lineage exists to ship
+            shards = getattr(store, "shards", None)
+            with store.locked():
+                rv = applied_rv_of(store)
+            return {"ok": True, "rv": rv,
+                    "shards": len(shards) if shards is not None else 1,
+                    "durable": getattr(store, "data_dir", None)
+                    is not None}
+        if op == "bootstrap":
+            # newest valid on-disk snapshot (replica seed); the WAL
+            # records past its rv arrive over the ship stream
+            src = _ship_source(store, req.get("shard"))
+            rv, state = src.newest_snapshot_state()
+            return {"ok": True, "rv": rv, "state": state}
         if op == "ping":
             return {"ok": True}
         if op == "auth":
@@ -501,6 +575,107 @@ class _Handler(socketserver.BaseRequestHandler):
         finally:
             for kind, listener in listeners:
                 store.unwatch(kind, listener)
+
+    def _serve_ship(self, sock: socket.socket, store: ClusterStore,
+                    req: dict) -> None:
+        """Stream WAL records committed after ``since_rv`` to a replica:
+        sealed segments + the already-durable tail replayed off disk
+        (``read_frames``' CRC/torn-tail discipline — a torn record and
+        everything after it never ships), then live records as they
+        commit, coalesced into batched frames. Refuses with
+        ResumeGapError when ``since_rv`` predates the retained-segment
+        window — the replica must close that hole with a fresh snapshot
+        bootstrap, never by skipping. The ``wal_ship`` fault point fires
+        at every frame send (arm ``exc:`` to drop the link mid-segment,
+        ``exc:exit`` to SIGKILL the primary there); the replica's
+        record-continuity check is the backstop for anything this stream
+        could lose."""
+        from .durable import _segment_paths, read_frames
+        try:
+            src = _ship_source(store, req.get("shard"))
+        except Exception as e:  # noqa: BLE001 — refuse, keep the conn clean
+            name = type(e).__name__
+            send_frame(sock, {"ok": False,
+                              "error": name if name in _ERRORS
+                              else "RuntimeError", "message": str(e)})
+            return
+        since_rv = int(req.get("since_rv", 0))
+        events: "queue.Queue" = queue.Queue(maxsize=WATCH_QUEUE_MAX)
+        overflowed = threading.Event()
+        sock.settimeout(WATCH_SEND_TIMEOUT_S)
+
+        def on_record(rec) -> None:
+            if overflowed.is_set():
+                return
+            try:
+                events.put_nowait(rec)
+            except queue.Full:
+                overflowed.set()
+
+        with src._lock:
+            floor = src.ship_floor()
+            if since_rv < floor:
+                send_frame(sock, {
+                    "ok": False, "error": "ResumeGapError",
+                    "message": f"retained WAL window starts after rv "
+                               f"{floor}; cannot resume from {since_rv}"})
+                return
+            # registration + segment listing + rv capture under ONE lock
+            # hold: every record <= live_from is fully flushed to these
+            # segments, every record > live_from arrives via the hook —
+            # no record can fall between disk replay and live tail
+            live_from = src._rv
+            segments = _segment_paths(src.data_dir)
+            src.add_ship_listener(on_record)
+        try:
+            send_frame(sock, {"ok": True, "rv": live_from})
+            batch: list = []
+
+            def flush() -> None:
+                if batch:
+                    faults.fire("wal_ship")
+                    send_frame(sock, {"stream": "wal", "recs": batch,
+                                      "prv": live_from})
+                    del batch[:]
+
+            for path in segments:
+                records, _, _torn = read_frames(path)
+                for rec in records:
+                    if since_rv < int(rec["rv"]) <= live_from:
+                        batch.append(rec)
+                        if len(batch) >= SHIP_BATCH_MAX:
+                            flush()
+            flush()
+            send_frame(sock, {"stream": "ship_synced", "rv": live_from})
+            while not overflowed.is_set():
+                try:
+                    rec = events.get(timeout=10.0)
+                except queue.Empty:
+                    # heartbeat carries the primary's current rv so an
+                    # idle replica can report zero lag (and a lagging
+                    # one honest lag) without any commit traffic
+                    send_frame(sock, {"stream": "heartbeat",
+                                      "prv": src._rv})
+                    continue
+                recs = [rec]
+                while len(recs) < SHIP_BATCH_MAX:
+                    try:
+                        recs.append(events.get_nowait())
+                    except queue.Empty:
+                        break
+                faults.fire("wal_ship")
+                send_frame(sock, {"stream": "wal", "recs": recs,
+                                  "prv": src._rv})
+            log.warning("ship stream overflowed %d records; dropping the "
+                        "slow replica (it resumes at its applied rv)",
+                        WATCH_QUEUE_MAX)
+        except socket.timeout:
+            log.warning("ship send stalled > %.0fs; dropping the slow "
+                        "replica", WATCH_SEND_TIMEOUT_S)
+        except (ConnectionError, OSError, ValueError):
+            pass  # replica went away; it resumes from its applied rv
+        finally:
+            src.remove_ship_listener(on_record)
 
 
 class StoreServer:

@@ -64,6 +64,21 @@ class ShardUnavailableError(Exception):
     commit — a dead shard costs its objects, not the wave."""
 
 
+class ReplicaReadOnlyError(Exception):
+    """A mutating op (create/update/apply/delete/bulk_apply) reached a
+    read replica. Replicas serve list/get/watch with explicit staleness;
+    every write — and with it fencing, leases and conditional-update
+    arbitration — belongs to the primary, so the op fails CLOSED with
+    this typed error instead of forking the object's history."""
+
+
+class ReplicaLagError(Exception):
+    """An rv-bounded read (``min_rv=`` on list) timed out before the
+    replica applied that resource_version: the caller asked for
+    read-your-writes freshness the replica cannot yet prove. The caller
+    retries, raises its bound, or falls back to the primary."""
+
+
 def _key(obj) -> str:
     ns = getattr(obj, "namespace", None)
     return f"{ns}/{obj.name}" if ns is not None else obj.name

@@ -422,6 +422,37 @@ store_shard_dropped_total = registry.register(Counter(
     "Events discarded per shard when a condemned (overflowed/stalled) "
     "watch stream was dropped", ["shard"]))
 
+# -- read replica metrics (client/replica.py) -------------------------------
+
+replica_applied_rv = registry.register(Gauge(
+    "volcano_replica_applied_rv",
+    "Primary resource_version this replica's mirror reflects, per "
+    "shipped WAL lineage (shard '0' for an unsharded primary)",
+    ["shard"]))
+replica_lag_records = registry.register(Gauge(
+    "volcano_replica_lag_records",
+    "WAL records the primary has committed that this replica has not "
+    "yet applied (primary rv seen on the ship stream - applied rv)",
+    ["shard"]))
+replica_lag_seconds = registry.register(Gauge(
+    "volcano_replica_lag_seconds",
+    "Age of the replica's applied state while it lags (now - the WAL "
+    "commit stamp of the last applied record; 0 when caught up)",
+    ["shard"]))
+replica_bootstraps_total = registry.register(Counter(
+    "volcano_replica_bootstraps_total",
+    "Replica snapshot bootstraps by reason: initial (startup), "
+    "out_of_window (fell past the primary's retained-segment window), "
+    "apply_gap (rv discontinuity detected — a lost or duplicated "
+    "shipped record). Every hole ends here, never in a silent skip",
+    ["reason"]))
+replica_ship_bytes_total = registry.register(Counter(
+    "volcano_replica_ship_bytes_total",
+    "Wire bytes received on the WAL ship stream(s)", ["shard"]))
+replica_watchers = registry.register(Gauge(
+    "volcano_replica_watchers",
+    "Watch/bulk_watch streams currently served by this replica"))
+
 # -- global rescheduler metrics (reschedule/) -------------------------------
 
 reschedule_plans_total = registry.register(Counter(

@@ -69,7 +69,19 @@ moved, the mark never landed, and the next flatten's consistency-epoch
 check detects the skew and falls back to the full re-diff instead of
 assembling from a stale layout), and ``flatten_event_dup`` (same seam,
 after the mark — an armed firing applies the delta a second time,
-skewing the epoch the other way; detection and fallback are identical).
+skewing the epoch the other way; detection and fallback are identical),
+``wal_ship`` (client/server.py _serve_ship, at every segment-stream
+frame send — arm ``exc:`` to drop the link mid-segment so the replica
+must resume at a record boundary, ``exc:exit`` to SIGKILL the primary
+exactly there; only complete CRC-clean frames ever applied, so the
+replica sits at a consistent rv prefix either way), ``replica_apply``
+(client/replica.py tailer, before one shipped record applies — an
+armed firing DROPS the record; the replica's rv-continuity check
+refuses the NEXT record and re-bootstraps from a fresh snapshot,
+counted in volcano_replica_bootstraps_total{reason="apply_gap"} —
+never a silently served gap), and ``replica_apply_dup`` (same seam,
+after the apply — an armed firing applies the record a second time;
+the rv repeat is refused immediately, same re-bootstrap).
 """
 
 from __future__ import annotations

@@ -327,6 +327,51 @@ class Standalone:
         self.store.create("jobs", _job_from_yaml(yaml.safe_load(text)))
 
 
+def run_replica(primary: str, serve: str, metrics_port: int = 0) -> int:
+    """Replica-only process mode (``--store-replica-of``): no scheduler,
+    no controllers, no webhooks — bootstrap from the primary's newest
+    snapshot, tail its shipped WAL, and serve the read tier
+    (list/get/watch/bulk_watch with explicit rv-bounded staleness;
+    mutations fail closed with ReplicaReadOnlyError)."""
+    import signal
+
+    from .client import ReplicaStore
+    from .metrics.server import MetricsServer
+
+    host, _, port = serve.rpartition(":")
+    host = host or "127.0.0.1"
+    token = os.environ.get("VOLCANO_STORE_TOKEN", "")
+    if not token and host not in ("127.0.0.1", "localhost", "::1"):
+        # the replica mirrors Secrets and the HA lease: the same
+        # fail-closed exposure rule as --serve-store applies
+        raise ValueError(
+            f"--serve-replica on non-loopback {host!r} requires a "
+            "shared token (set VOLCANO_STORE_TOKEN)")
+    tls_cert = os.environ.get("VOLCANO_STORE_TLS_CERT") or None
+    tls_key = os.environ.get("VOLCANO_STORE_TLS_KEY") or None
+    replica = ReplicaStore(primary, token=token or None,
+                           tls_ca=os.environ.get("VOLCANO_STORE_CA")
+                           or None)
+    server = replica.serve(host, int(port), token=token or None,
+                           tls_cert=tls_cert, tls_key=tls_key)
+    replica.start()
+    metrics_server = MetricsServer(port=metrics_port).start()
+    print(f"volcano-tpu replica up; following {primary}; serving reads "
+          f"on {server.address}; metrics on :{metrics_server.port}",
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        metrics_server.stop()
+        replica.close()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="volcano-tpu-standalone")
     ap.add_argument("--conf", help="scheduler conf YAML path")
@@ -383,6 +428,21 @@ def main(argv=None) -> int:
                          "through one endpoint speaking the unchanged "
                          "wire protocol. Default 1: the exact "
                          "historical single-store code paths")
+    ap.add_argument("--store-replica-of", metavar="HOST:PORT",
+                    dest="store_replica_of",
+                    help="run as a READ REPLICA of the durable store at "
+                         "HOST:PORT (a --serve-store primary with "
+                         "--store-data-dir): bootstrap from its newest "
+                         "snapshot, tail its shipped WAL, and serve "
+                         "list/watch with explicit rv-bounded staleness "
+                         "on --serve-replica. Replica mode runs NO "
+                         "scheduler/controllers; mutations against the "
+                         "replica fail closed")
+    ap.add_argument("--serve-replica", metavar="[HOST:]PORT",
+                    dest="serve_replica",
+                    help="bind address for the replica read endpoint "
+                         "(requires --store-replica-of; same wire "
+                         "protocol and auth/TLS rules as --serve-store)")
     ap.add_argument("--controller-shard-workers", type=int, default=1,
                     metavar="N",
                     help="fan the job controller's sync drain out "
@@ -478,6 +538,15 @@ def main(argv=None) -> int:
                          "which a plan is rejected as no-op churn "
                          "(default 0.01; conf: reschedule.minImprovement)")
     args = ap.parse_args(argv)
+
+    if args.store_replica_of:
+        if not args.serve_replica:
+            ap.error("--store-replica-of requires --serve-replica "
+                     "(a replica exists to serve reads)")
+        return run_replica(args.store_replica_of, args.serve_replica,
+                           metrics_port=args.metrics_port)
+    if args.serve_replica:
+        ap.error("--serve-replica requires --store-replica-of")
 
     conf = None
     if args.conf:
