@@ -2248,17 +2248,23 @@ def store_shard_scale():
             p.wait(timeout=30)
         return events, time.perf_counter() - t0, t0
 
-    def one_arm(n_shards, serial_baseline):
+    def one_arm(n_shards, serial_baseline, procs=False):
         from volcano_tpu.cache import FakeEvictor, SchedulerCache
         from volcano_tpu.scheduler import Scheduler
 
         port = free_port()
-        server = start_store_proc(port, "", shards=n_shards)
+        server = start_store_proc(port, "", shards=n_shards,
+                                  shard_procs=procs)
         addr = f"127.0.0.1:{port}"
-        arm = {"shards": n_shards}
+        arm = {"shards": n_shards, "procs": procs}
         clients = []
 
         def client(**kw):
+            # the proc arm's mirror/cache clients route like real
+            # deployments: single-key ops direct to the owning worker,
+            # watch streams straight off the workers (router bypassed)
+            if procs:
+                kw.setdefault("direct_watch", True)
             c = RemoteClusterStore(addr, **kw)
             clients.append(c)
             return c
@@ -2383,27 +2389,51 @@ def store_shard_scale():
             except Exception:  # noqa: BLE001
                 pass
 
-    # the rig is 6 cooperating PROCESSES (server, driver, 4 writers):
-    # sustained events/sec scales with cores, so the artifact records
-    # how many this box had — on 1 core the 50k floor is unreachable
-    # by construction and the per-arm comparison is the signal
+    # the rig is 6 cooperating PROCESSES (server, driver, 4 writers) —
+    # plus, in the proc_shards arm, one process PER SHARD behind the
+    # thin router: sustained events/sec scales with cores, so the
+    # artifact records how many this box had — on 1 core the 50k floor
+    # is unreachable by construction and the per-arm comparison is the
+    # signal
     out = {"arms": {}, "cpu_count": os.cpu_count()}
     serial_rate = None
-    for n_shards in (1, 4, 8):
-        arm = _run_config(f"store_shard_scale[{n_shards}]",
-                          lambda n=n_shards: one_arm(n, n == 1))
-        out["arms"][str(n_shards)] = arm
-        if n_shards == 1 and "burst_serial_pods_per_sec" in arm:
+    for label, n_shards, procs in (
+            ("1", 1, False), ("4", 4, False), ("8", 8, False),
+            ("proc8", 8, True)):
+        arm = _run_config(f"store_shard_scale[{label}]",
+                          lambda n=n_shards, p=procs:
+                          one_arm(n, n == 1 and not p, procs=p))
+        out["arms"][label] = arm
+        if label == "1" and "burst_serial_pods_per_sec" in arm:
             serial_rate = arm["burst_serial_pods_per_sec"]
     a8 = out["arms"].get("8", {})
+    ap = out["arms"].get("proc8", {})
     if serial_rate and a8.get("burst_bulk_pods_per_sec"):
         out["burst_ingest_speedup_vs_serial1"] = round(
             a8["burst_bulk_pods_per_sec"] / serial_rate, 2)
+    if serial_rate and ap.get("burst_bulk_pods_per_sec"):
+        out["proc_burst_ingest_speedup_vs_serial1"] = round(
+            ap["burst_bulk_pods_per_sec"] / serial_rate, 2)
+    # ISSUE 13 acceptance: real processes beat the one-GIL shards=8 arm
+    # on sustained mirror events/sec AND burst ingest, without
+    # stretching the live scheduler's cycle more — and the absolute 50k
+    # events/sec floor is gated honestly (cpu_count recorded: the
+    # multi-process rig is the first topology that can actually scale
+    # past one core, but only on a rig that HAS the cores)
+    out["proc_beats_inproc"] = bool(
+        ap.get("churn_mirror_complete") and a8.get("churn_mirror_complete")
+        and (ap.get("churn_events_per_sec") or 0)
+        >= (a8.get("churn_events_per_sec") or 0)
+        and (ap.get("burst_bulk_pods_per_sec") or 0)
+        >= (a8.get("burst_bulk_pods_per_sec") or 0)
+        and (ap.get("cycle_stretch") or 9)
+        <= (a8.get("cycle_stretch") or 0))
     out["ok"] = bool(
-        a8.get("churn_mirror_complete")
-        and (a8.get("churn_events_per_sec") or 0) >= 50_000
-        and (a8.get("cycle_stretch") or 9) <= 1.10
-        and (out.get("burst_ingest_speedup_vs_serial1") or 0) >= 3.0)
+        out["proc_beats_inproc"]
+        and (ap.get("churn_events_per_sec") or 0) >= 50_000
+        and (ap.get("cycle_stretch") or 9) <= 1.10
+        and (out.get("proc_burst_ingest_speedup_vs_serial1") or 0)
+        >= 3.0)
     return out
 
 
@@ -2452,16 +2482,21 @@ def read_replica_fanout():
                 break
         raise RuntimeError(f"{what} failed to start")
 
-    def one_arm(n_replicas):
+    def rv_scalar(rv):
+        # a multi-process router reports {shard: rv}; per-shard rvs sum
+        # to the total committed mutations (shards=1: the one lineage)
+        return sum(rv.values()) if isinstance(rv, dict) else rv
+
+    def one_arm(n_replicas, proc_primary=False):
         from volcano_tpu.cache import FakeEvictor, SchedulerCache
         from volcano_tpu.scheduler import Scheduler
 
         work = tempfile.mkdtemp(prefix="volcano-replica-bench-")
         pport = free_port()
         server = start_store_proc(pport, os.path.join(work, "pdata"),
-                                  fsync="off")
+                                  fsync="off", shard_procs=proc_primary)
         addr = f"127.0.0.1:{pport}"
-        arm = {"replicas": n_replicas}
+        arm = {"replicas": n_replicas, "proc_primary": proc_primary}
         clients = []
         procs = [server]
 
@@ -2501,10 +2536,16 @@ def read_replica_fanout():
             targets = []
             for r in range(n_replicas):
                 rport = free_port()
+                cmd = [sys.executable,
+                       os.path.join(TESTS, "replica_proc.py"),
+                       "--primary", addr, "--port", str(rport)]
+                if proc_primary:
+                    # tail the shard WORKER directly (resolved via the
+                    # router's topology op): ship bytes never traverse
+                    # the router process
+                    cmd.append("--topology-direct")
                 rp = subprocess.Popen(
-                    [sys.executable,
-                     os.path.join(TESTS, "replica_proc.py"),
-                     "--primary", addr, "--port", str(rport)],
+                    cmd,
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True, cwd=os.path.dirname(TESTS))
                 wait_ready(rp, f"replica {r}")
@@ -2550,9 +2591,11 @@ def read_replica_fanout():
             def sample_lag():
                 while not stop.is_set():
                     try:
-                        prv = prv_info._request({"op": "store_info"})["rv"]
+                        prv = rv_scalar(
+                            prv_info._request({"op": "store_info"})["rv"])
                         for ri in rep_info:
-                            arv = ri._request({"op": "store_info"})["rv"]
+                            arv = rv_scalar(ri._request(
+                                {"op": "store_info"})["rv"])
                             lag_samples.append(max(0, prv - arv))
                     except Exception:  # noqa: BLE001 — sampling only
                         pass
@@ -2594,9 +2637,11 @@ def read_replica_fanout():
             # let the read tier drain: replicas must catch the primary
             def drained():
                 try:
-                    prv = prv_info._request({"op": "store_info"})["rv"]
-                    return all(ri._request({"op": "store_info"})["rv"]
-                               == prv for ri in rep_info)
+                    prv = rv_scalar(
+                        prv_info._request({"op": "store_info"})["rv"])
+                    return all(
+                        rv_scalar(ri._request({"op": "store_info"})["rv"])
+                        == prv for ri in rep_info)
                 except Exception:  # noqa: BLE001
                     return False
 
@@ -2657,13 +2702,23 @@ def read_replica_fanout():
     # read storm is the signal, and it depends on the storm NOT sharing
     # the scheduler's GIL — record the core budget honestly
     out = {"arms": {}, "cpu_count": os.cpu_count()}
-    for n_replicas in (0, 1, 2):
-        out["arms"][f"replicas_{n_replicas}"] = _run_config(
-            f"read_replica_fanout[{n_replicas}]",
-            lambda n=n_replicas: one_arm(n))
+    for label, n_replicas, proc in (
+            ("replicas_0", 0, False), ("replicas_1", 1, False),
+            ("replicas_2", 2, False), ("replicas_1_proc", 1, True)):
+        out["arms"][label] = _run_config(
+            f"read_replica_fanout[{label}]",
+            lambda n=n_replicas, p=proc: one_arm(n, proc_primary=p))
     r1 = out["arms"].get("replicas_1", {})
     r0 = out["arms"].get("replicas_0", {})
+    r1p = out["arms"].get("replicas_1_proc", {})
     out["primary_only_stretch"] = r0.get("cycle_stretch")
+    # the multi-process arm: the primary's one shard is a real worker
+    # process and the replica tails ITS endpoint directly, so ship
+    # fan-out shares neither the router's nor the scheduler's GIL —
+    # gated with the same stretch floor, recorded per cpu_count
+    out["proc_arm_ok"] = bool(
+        r1p.get("replica_caught_up")
+        and (r1p.get("cycle_stretch") or 9) <= 1.05)
     out["ok"] = bool(
         r1.get("replica_caught_up")
         and (r1.get("cycle_stretch") or 9) <= 1.05

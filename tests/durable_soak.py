@@ -42,13 +42,20 @@ def free_port() -> int:
 def start_store_proc(port: int, data_dir: str, fsync: str = "every",
                      snapshot_every: int = 4096,
                      timeout: float = 60.0,
-                     shards: int = 1) -> subprocess.Popen:
+                     shards: int = 1,
+                     shard_procs: bool = False,
+                     worker_faults=None) -> subprocess.Popen:
     """Launch store_server_proc.py and wait for its READY line."""
+    cmd = [sys.executable, os.path.join(TESTS_DIR, "store_server_proc.py"),
+           "--port", str(port), "--data-dir", data_dir,
+           "--fsync", fsync, "--snapshot-every", str(snapshot_every),
+           "--shards", str(shards)]
+    if shard_procs:
+        cmd.append("--shard-procs")
+    if worker_faults:
+        cmd += ["--worker-faults", worker_faults]
     proc = subprocess.Popen(
-        [sys.executable, os.path.join(TESTS_DIR, "store_server_proc.py"),
-         "--port", str(port), "--data-dir", data_dir,
-         "--fsync", fsync, "--snapshot-every", str(snapshot_every),
-         "--shards", str(shards)],
+        cmd,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=os.path.dirname(TESTS_DIR))
     deadline = time.time() + timeout
@@ -85,14 +92,23 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
                          snapshot_every: int = 4096,
                          wait_s: float = 30.0,
                          shards: int = 1,
-                         bulk_watch: bool = False) -> dict:
+                         bulk_watch: bool = False,
+                         shard_procs: bool = False,
+                         kill_worker=None,
+                         direct_watch: bool = False) -> dict:
     """Run the soak; ``kill_at_wave=k`` SIGKILLs + restarts the store
     process after wave k's pods are durable but before the solve that
     binds them (the worst quiescent point: the whole wave exists ONLY in
     the store). Returns the decision trace + ride-through evidence.
     ``shards`` > 1 runs the store process as a ShardRouter over N
     per-shard WAL lineages (the kill must then heal every shard);
-    ``bulk_watch`` subscribes the controllers over one batched stream."""
+    ``bulk_watch`` subscribes the controllers over one batched stream.
+    ``shard_procs`` promotes every shard to its own worker PROCESS
+    behind the supervising ProcShardRouter; ``kill_worker=i`` then aims
+    the wave-``kill_at_wave`` SIGKILL at shard i's WORKER (pid resolved
+    via the ``topology`` op) and waits for the supervisor's capped-
+    backoff restart instead of bouncing the whole store; ``direct_watch``
+    routes the driver's watch streams straight to the workers."""
     from helpers import build_node, build_queue
     from volcano_tpu.cache import FakeEvictor, SchedulerCache
     from volcano_tpu.client import RemoteClusterStore
@@ -102,12 +118,13 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
 
     port = free_port()
     proc = start_store_proc(port, data_dir, fsync=fsync,
-                            snapshot_every=snapshot_every, shards=shards)
+                            snapshot_every=snapshot_every, shards=shards,
+                            shard_procs=shard_procs)
     crash_resyncs = []
     remote = RemoteClusterStore(
         f"127.0.0.1:{port}", connect_timeout=2.0,
         retry_attempts=10, retry_base_s=0.1, retry_cap_s=1.0,
-        watch_backoff_cap_s=0.5,
+        watch_backoff_cap_s=0.5, direct_watch=direct_watch,
         on_watch_failure=lambda: crash_resyncs.append(1))
     result = {
         "waves": waves, "kill_at_wave": kill_at_wave,
@@ -185,11 +202,32 @@ def run_store_crash_soak(data_dir: str, waves: int = 10,
             if kill_at_wave == w:
                 # the whole wave now exists ONLY in the store. Kill -9.
                 t0 = time.time()
-                proc.kill()
-                proc.wait(timeout=10)
-                proc = start_store_proc(port, data_dir, fsync=fsync,
-                                        snapshot_every=snapshot_every,
-                                        shards=shards)
+                if kill_worker is not None:
+                    # aim at ONE shard worker: SIGKILL its pid and let
+                    # the SUPERVISOR restart it on the same port + data
+                    # dir (construction-is-recovery); the other shards
+                    # keep serving throughout
+                    import signal as _signal
+                    topo = remote._request({"op": "topology"})
+                    victim = topo["workers"][kill_worker]
+                    os.kill(victim["pid"], _signal.SIGKILL)
+                    deadline = time.time() + 30
+                    while time.time() < deadline:
+                        topo = remote._request({"op": "topology"})
+                        ww = topo["workers"][kill_worker]
+                        if ww["alive"] and ww["restarts"] \
+                                > victim["restarts"]:
+                            break
+                        time.sleep(0.05)
+                    result["worker_restarts"] = \
+                        topo["workers"][kill_worker]["restarts"]
+                else:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                    proc = start_store_proc(port, data_dir, fsync=fsync,
+                                            snapshot_every=snapshot_every,
+                                            shards=shards,
+                                            shard_procs=shard_procs)
                 result["restart_s"] = round(time.time() - t0, 2)
 
             def mirror_has_wave(name):

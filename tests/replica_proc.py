@@ -28,15 +28,32 @@ def main() -> int:
     ap.add_argument("--primary", required=True)
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--faults", default=None)
+    ap.add_argument("--topology-direct", action="store_true",
+                    help="--primary names a multi-process shard ROUTER: "
+                         "resolve its topology and tail the shard-0 "
+                         "WORKER endpoint directly, so ship bytes never "
+                         "traverse the router process")
     args = ap.parse_args()
 
-    from volcano_tpu.client import ReplicaStore
+    from volcano_tpu.client import RemoteClusterStore, ReplicaStore
     from volcano_tpu.resilience import faults
 
     if args.faults:
         faults.configure(args.faults)
 
-    replica = ReplicaStore(args.primary)
+    primary = args.primary
+    if args.topology_direct:
+        probe = RemoteClusterStore(primary)
+        try:
+            topo = probe._request({"op": "topology"})
+        finally:
+            probe.close()
+        endpoints = topo.get("endpoints") or []
+        if endpoints:
+            primary = endpoints[0]
+            print(f"# tailing worker directly at {primary}", flush=True)
+
+    replica = ReplicaStore(primary)
     server = replica.serve(port=args.port)
     replica.start()
     print(f"READY {server.port} applied={replica.applied_rv()}",

@@ -38,6 +38,15 @@ def main() -> int:
                     help="serve a sharded store (per-shard WAL lineages "
                          "under data-dir/shard-NNN) through a "
                          "ShardRouter on the same wire protocol")
+    ap.add_argument("--shard-procs", action="store_true",
+                    help="promote each shard to its OWN OS process "
+                         "(client/shardproc.py): this process becomes "
+                         "the thin supervising ProcShardRouter, same "
+                         "wire protocol, SAME data-dir layout")
+    ap.add_argument("--worker-faults", default=None,
+                    help="fault spec armed in every shard WORKER "
+                         "process (e.g. shard_proc_crash=at:40,"
+                         "exc:exit)")
     args = ap.parse_args()
 
     from volcano_tpu.client import DurableClusterStore, StoreServer
@@ -46,7 +55,18 @@ def main() -> int:
     if args.faults:
         faults.configure(args.faults)
 
-    if args.shards > 1:
+    if args.shard_procs:
+        from volcano_tpu.client import (
+            ProcShardRouter, ProcShardedStore, ShardProcSupervisor,
+        )
+        sup = ShardProcSupervisor(
+            max(1, args.shards), data_dir=args.data_dir or None,
+            fsync=args.fsync, snapshot_every=args.snapshot_every,
+            admission=False, worker_faults=args.worker_faults,
+            restart_backoff_base_s=0.1).start()
+        store = ProcShardedStore(sup)
+        server = ProcShardRouter(store, port=args.port).start()
+    elif args.shards > 1:
         from volcano_tpu.client import ShardedClusterStore, ShardRouter
         store = ShardedClusterStore(args.shards,
                                     data_dir=args.data_dir or None,

@@ -320,6 +320,57 @@ def queue_delete(args, cluster: ClusterStore) -> str:
 
 
 # ---------------------------------------------------------------------------
+# status command (store topology + shard-worker liveness)
+# ---------------------------------------------------------------------------
+
+def status_cmd(args, cluster: ClusterStore) -> str:
+    """Control-plane store status: shape, durability, rv(s) — and, for
+    a multi-process sharded deployment, the shard map with per-worker
+    endpoint, liveness, pid, restart count, uptime and ingest rate."""
+    req = getattr(cluster, "_request", None)
+    if req is None:
+        shards = getattr(cluster, "n_shards", 1)
+        durable = getattr(cluster, "data_dir", None) is not None
+        return (f"store: in-process, shards={shards}, "
+                f"durable={'yes' if durable else 'no'}, "
+                f"rv={getattr(cluster, '_rv', 0)}")
+    info = req({"op": "store_info"})
+    try:
+        topo = req({"op": "topology"})
+    except Exception:  # noqa: BLE001 — pre-topology server
+        topo = {"n_shards": info.get("shards", 1), "endpoints": []}
+    rv = info.get("rv")
+    lines = [f"store: shards={topo.get('n_shards', 1)}, "
+             f"durable={'yes' if info.get('durable') else 'no'}, "
+             f"recovered_records={info.get('recovered', 0)}"]
+    workers = topo.get("workers") or []
+    if workers:
+        rows = []
+        for w in workers:
+            shard = str(w.get("shard"))
+            shard_rv = (rv.get(shard) if isinstance(rv, dict)
+                        else (rv if shard == "0" else ""))
+            rows.append([shard, w.get("endpoint", ""),
+                         "up" if w.get("alive") else "DOWN",
+                         str(w.get("pid") or "-"),
+                         str(w.get("restarts", 0)),
+                         str(w.get("uptime_s", "")),
+                         str(w.get("events_per_sec", "")),
+                         str(shard_rv if shard_rv is not None else "")])
+        lines.append(_table(
+            ["Shard", "Endpoint", "State", "Pid", "Restarts",
+             "Uptime(s)", "Events/s", "Rv"], rows))
+    elif isinstance(rv, dict):
+        lines.append(_table(
+            ["Shard", "Rv"],
+            [[sh, str(v)] for sh, v in sorted(rv.items())])
+            + "\n(shards share the server process; no direct endpoints)")
+    else:
+        lines.append(f"rv: {rv}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # sim command (volcano_tpu.sim: trace-driven scheduling-quality harness)
 # ---------------------------------------------------------------------------
 
@@ -506,6 +557,11 @@ def build_parser() -> argparse.ArgumentParser:
                       default=1, dest="reschedule_max_disruption",
                       help="PDB-style per-job disruption cap per plan")
 
+    sub.add_parser(
+        "status", help="store topology + shard-worker liveness "
+                       "(per-worker pid/restarts/uptime/ingest against "
+                       "a multi-process sharded deployment)")
+
     sub.add_parser("version")
     return p
 
@@ -524,6 +580,7 @@ _DISPATCH = {
     ("queue", "delete"): queue_delete,
     ("apply", None): apply_file,
     ("sim", None): sim_cmd,
+    ("status", None): status_cmd,
 }
 
 #: standalone binary aliases (cmd/cli/{vsub,vjobs,...})
@@ -539,7 +596,8 @@ ALIASES = {
 
 #: (group, verb) pairs safe to serve from a read replica
 _READ_VERBS = {("job", "list"), ("job", "view"),
-               ("queue", "list"), ("queue", "get")}
+               ("queue", "list"), ("queue", "get"),
+               ("status", None)}
 
 
 def main(argv: List[str], cluster: Optional[ClusterStore] = None) -> str:

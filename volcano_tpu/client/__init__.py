@@ -12,6 +12,10 @@ from .server import StoreServer  # noqa: F401
 from .sharded import (  # noqa: F401
     ShardedClusterStore, ShardRouter, shard_for,
 )
+from .shardproc import (  # noqa: F401
+    ProcShardRouter, ProcShardedStore, ShardProcSupervisor,
+    ShardWorkerServer,
+)
 from .store import (  # noqa: F401
     AdmissionError, ClusterStore, ConflictError, FencedError, FencedStore,
     NotFoundError, ReplicaLagError, ReplicaReadOnlyError, ResumeGapError,

@@ -32,7 +32,8 @@ from typing import Any, Callable, Dict, List, Optional
 from ..resilience.faultinject import faults
 from .codec import decode, encode
 from .server import MAGIC, raise_remote, recv_frame, remote_error, send_frame
-from .store import ResumeGapError
+from .sharded import shard_for
+from .store import ResumeGapError, ShardUnavailableError, _key
 
 log = logging.getLogger(__name__)
 
@@ -63,11 +64,26 @@ class RemoteClusterStore:
     - ``retry_attempts``/``retry_base_s``/``retry_cap_s``: idempotent-op
       retry budget (see _request) — defaults ride out a ~3 s server
       restart.
-    - ``pool_size``: request connections kept to the server (default 1,
+    - ``pool_size``: request connections kept PER ENDPOINT (default 1,
       the historical single-socket behavior). With N > 1, up to N
-      requests are in flight concurrently — the seam that lets fanned-
-      out controller workers ingest in parallel instead of queueing
-      behind one socket.
+      requests are in flight concurrently per endpoint — the seam that
+      lets fanned-out controller workers ingest in parallel instead of
+      queueing behind one socket, and that keeps direct shard
+      connections from serializing through the router's pool.
+    - ``direct_routing`` (default True): ask the server for its shard
+      ``topology`` once (lazily, on first routed op) and, when it
+      names per-shard worker endpoints (the multi-process router,
+      client/shardproc.py), send single-key CRUD/get straight to the
+      owning shard — ``crc32(kind/ns/name) % N`` is deterministic and
+      client-visible, so the router hop survives only for cross-shard
+      ops (list, bulk waves, bulk_watch merge). Old servers without the
+      op, single-process topologies, and TLS deployments (workers are
+      loopback-plaintext) all degrade gracefully to router-only
+      routing; so does any direct request whose connection fails before
+      it could have been applied.
+    - ``direct_watch`` (default False): also open watch/bulk_watch
+      streams per shard worker directly — events bypass the router
+      entirely; each stream resumes against its own worker's journal.
     """
 
     def __init__(self, address: str, connect_timeout: float = 5.0,
@@ -82,7 +98,9 @@ class RemoteClusterStore:
                  watch_resume: bool = True,
                  watch_resume_window_s: float = 30.0,
                  watch_backoff_cap_s: float = 2.0,
-                 pool_size: int = 1):
+                 pool_size: int = 1,
+                 direct_routing: bool = True,
+                 direct_watch: bool = False):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -138,14 +156,26 @@ class RemoteClusterStore:
         #: applied_rv of the most recent list response (staleness at a
         #: glance for CLIs/dashboards)
         self.last_list_applied_rv = None
-        # request-connection pool: idle sockets ready for checkout, a
-        # live count capping concurrency at pool_size, and the full set
-        # so close() can unblock an in-flight recv
+        # request-connection pools, one PER ENDPOINT (the router, plus —
+        # direct-routed — each shard worker): idle sockets ready for
+        # checkout, a live count capping concurrency at pool_size per
+        # endpoint, and the full set so close() can unblock an in-flight
+        # recv
         self.pool_size = max(1, int(pool_size))
         self._pool_cv = threading.Condition()
-        self._idle: List[socket.socket] = []
-        self._n_conns = 0
+        self._default_ep = (self.host, self.port)
+        self._pools: Dict[tuple, dict] = {}
         self._conns: set = set()
+        # direct shard routing (see class docstring): topology is
+        # fetched lazily, once; empty endpoints = router-only
+        self.direct_routing = direct_routing
+        self.direct_watch = direct_watch
+        self._topo_lock = threading.Lock()
+        self._topo_checked = False
+        self._n_shards = 1
+        self._shard_endpoints: List[tuple] = []
+        self.direct_requests = 0    # requests sent straight to a shard
+        self.direct_fallbacks = 0   # direct failures re-run via router
         self._watch_threads: List[threading.Thread] = []
         self._watch_socks: List[socket.socket] = []
         self._closed = False
@@ -153,12 +183,13 @@ class RemoteClusterStore:
 
     # -- plumbing -----------------------------------------------------------
 
-    def _connect(self) -> socket.socket:
-        sock = socket.create_connection((self.host, self.port),
+    def _connect(self, endpoint: Optional[tuple] = None) -> socket.socket:
+        host, port = endpoint or self._default_ep
+        sock = socket.create_connection((host, port),
                                         timeout=self.connect_timeout)
         if self._ssl_ctx is not None:
             sock = self._ssl_ctx.wrap_socket(
-                sock, server_hostname=self.host)
+                sock, server_hostname=host)
         sock.settimeout(None)
         sock.sendall(MAGIC)
         if self.token:
@@ -169,24 +200,34 @@ class RemoteClusterStore:
                 raise_remote(resp)
         return sock
 
-    def _acquire_conn(self) -> Optional[socket.socket]:
-        """Check a request connection out of the pool: an idle socket,
-        or None with a slot reserved (the caller connects outside the
-        pool lock). Blocks while pool_size requests are in flight."""
+    def _pool(self, ep: tuple) -> dict:
+        # caller holds self._pool_cv
+        pool = self._pools.get(ep)
+        if pool is None:
+            pool = self._pools[ep] = {"idle": [], "n": 0}
+        return pool
+
+    def _acquire_conn(self, ep: tuple) -> Optional[socket.socket]:
+        """Check a request connection out of the endpoint's pool: an
+        idle socket, or None with a slot reserved (the caller connects
+        outside the pool lock). Blocks while pool_size requests are in
+        flight TO THAT ENDPOINT — direct shard traffic never queues
+        behind the router's sockets."""
         with self._pool_cv:
             while True:
                 if self._closed:
                     raise ConnectionError("store client closed")
-                if self._idle:
-                    return self._idle.pop()
-                if self._n_conns < self.pool_size:
-                    self._n_conns += 1
+                pool = self._pool(ep)
+                if pool["idle"]:
+                    return pool["idle"].pop()
+                if pool["n"] < self.pool_size:
+                    pool["n"] += 1
                     return None
                 self._pool_cv.wait(0.1)
 
-    def _release_slot(self) -> None:
+    def _release_slot(self, ep: tuple) -> None:
         with self._pool_cv:
-            self._n_conns -= 1
+            self._pool(ep)["n"] -= 1
             self._pool_cv.notify()
 
     def _drop_conn(self, sock: socket.socket) -> None:
@@ -199,13 +240,13 @@ class RemoteClusterStore:
         except OSError:
             pass
 
-    def _checkin_conn(self, sock: socket.socket) -> None:
+    def _checkin_conn(self, ep: tuple, sock: socket.socket) -> None:
         with self._pool_cv:
             if self._closed:
                 self._conns.discard(sock)
-                self._n_conns -= 1
+                self._pool(ep)["n"] -= 1
             else:
-                self._idle.append(sock)
+                self._pool(ep)["idle"].append(sock)
             self._pool_cv.notify()
         if self._closed:
             try:
@@ -213,7 +254,72 @@ class RemoteClusterStore:
             except OSError:
                 pass
 
-    def _request(self, payload: dict) -> dict:
+    # -- direct shard routing ------------------------------------------------
+
+    def _ensure_topology(self) -> None:
+        """Fetch the server's shard topology ONCE (lazily): when it
+        names per-shard worker endpoints, single-key ops route straight
+        to the owning shard. Servers without the op (pre-topology), ok
+        responses without endpoints (single process — the in-process
+        router, a plain store, a replica), and TLS sessions (workers
+        speak loopback plaintext) all leave router-only routing in
+        place."""
+        if self._topo_checked:
+            return
+        with self._topo_lock:
+            if self._topo_checked:
+                return
+            eps: List[tuple] = []
+            n = 1
+            if self.direct_routing and self._ssl_ctx is None:
+                try:
+                    resp = self._request({"op": "topology"})
+                    n = int(resp.get("n_shards", 1))
+                    raw = resp.get("endpoints") or []
+                    if n > 1 and len(raw) == n:
+                        for addr in raw:
+                            host, _, port = addr.rpartition(":")
+                            eps.append((host or "127.0.0.1", int(port)))
+                except Exception:  # noqa: BLE001 — old server: no topology
+                    eps = []
+            if eps:
+                self._n_shards = n
+                self._shard_endpoints = eps
+                log.info("store topology: %d shards, direct routing to "
+                         "%s", n, resp.get("endpoints"))
+            self._topo_checked = True
+
+    def _endpoint_for(self, kind: str, key: str) -> Optional[tuple]:
+        self._ensure_topology()
+        if not self._shard_endpoints:
+            return None
+        return self._shard_endpoints[
+            shard_for(kind, key, self._n_shards)]
+
+    def _routed_request(self, kind: str, key: str, payload: dict) -> dict:
+        """A single-key op: straight to the owning shard worker when the
+        topology names one, with graceful fallback to the router when
+        the direct attempt fails without possibly having been applied
+        (a send that completed on a non-idempotent, non-conditional op
+        must NOT be blindly replayed through the router)."""
+        ep = self._endpoint_for(kind, key)
+        if ep is None:
+            return self._request(payload)
+        try:
+            resp = self._request(payload, endpoint=ep)
+        except (ConnectionError, OSError) as e:
+            if getattr(e, "_sent_unsafe", False):
+                raise
+            self.direct_fallbacks += 1
+            log.warning("direct shard request to %s failed (%s: %s); "
+                        "falling back to the router", ep,
+                        type(e).__name__, e)
+            return self._request(payload)
+        self.direct_requests += 1
+        return resp
+
+    def _request(self, payload: dict,
+                 endpoint: Optional[tuple] = None) -> dict:
         # Retry rules: a failed SEND is always safe to retry (the server
         # only acts on complete frames, and a broken connection can never
         # complete a partial one). A failure AFTER the send is ambiguous —
@@ -233,28 +339,29 @@ class RemoteClusterStore:
         # pool_size (default 1 — the historical one-socket serialization).
         op = payload.get("op")
         idempotent = op in ("get", "list", "ping", "store_info",
-                            "bootstrap")
+                            "bootstrap", "topology", "fence_check")
         conditional = op in ("create", "delete") or (
             op in ("update", "apply")
             and bool(((payload.get("obj") or {}).get("f") or {})
                      .get("resource_version")))
+        ep = endpoint or self._default_ep
         delay = self.retry_base_s
         attempt = 0
-        sock = self._acquire_conn()
+        sock = self._acquire_conn(ep)
         try:
             while True:
                 sent = False
                 try:
                     faults.fire("store_request")
                     if sock is None:
-                        sock = self._connect()
+                        sock = self._connect(ep)
                         with self._pool_cv:
                             self._conns.add(sock)
                     send_frame(sock, payload)
                     sent = True
                     resp = recv_frame(sock)
                     break
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError) as e:
                     if sock is not None:
                         self._drop_conn(sock)
                         sock = None
@@ -262,6 +369,12 @@ class RemoteClusterStore:
                     if (sent and not (idempotent or conditional)) \
                             or attempt > self.retry_attempts \
                             or self._closed:
+                        # the direct-routing fallback must know whether
+                        # this op may already have been APPLIED — only a
+                        # failure after a completed send on a
+                        # non-retryable op is unsafe to re-run elsewhere
+                        e._sent_unsafe = bool(  # type: ignore[attr-defined]
+                            sent and not (idempotent or conditional))
                         raise
                     try:
                         from ..metrics import metrics
@@ -273,9 +386,9 @@ class RemoteClusterStore:
         except BaseException:
             if sock is not None:
                 self._drop_conn(sock)
-            self._release_slot()
+            self._release_slot(ep)
             raise
-        self._checkin_conn(sock)
+        self._checkin_conn(ep, sock)
         if not resp.get("ok"):
             raise_remote(resp)
         return resp
@@ -286,7 +399,8 @@ class RemoteClusterStore:
         with self._pool_cv:
             conns = list(self._conns)
             self._conns.clear()
-            self._idle.clear()
+            for pool in self._pools.values():
+                pool["idle"].clear()
             self._pool_cv.notify_all()
         for sock in conns:
             try:
@@ -310,23 +424,28 @@ class RemoteClusterStore:
         return self._lock
 
     def create(self, kind: str, obj, fencing: Optional[dict] = None):
-        return decode(self._request(
+        return decode(self._routed_request(
+            kind, _key(obj),
             {"op": "create", "kind": kind, "obj": encode(obj),
              "fencing": fencing})["obj"])
 
     def update(self, kind: str, obj, fencing: Optional[dict] = None):
-        return decode(self._request(
+        return decode(self._routed_request(
+            kind, _key(obj),
             {"op": "update", "kind": kind, "obj": encode(obj),
              "fencing": fencing})["obj"])
 
     def apply(self, kind: str, obj, fencing: Optional[dict] = None):
-        return decode(self._request(
+        return decode(self._routed_request(
+            kind, _key(obj),
             {"op": "apply", "kind": kind, "obj": encode(obj),
              "fencing": fencing})["obj"])
 
     def delete(self, kind: str, name: str, namespace: Optional[str] = None,
                fencing: Optional[dict] = None):
-        return decode(self._request(
+        key = f"{namespace}/{name}" if namespace is not None else name
+        return decode(self._routed_request(
+            kind, key,
             {"op": "delete", "kind": kind, "name": name,
              "namespace": namespace, "fencing": fencing})["obj"])
 
@@ -389,7 +508,9 @@ class RemoteClusterStore:
         return results
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None):
-        return decode(self._request(
+        key = f"{namespace}/{name}" if namespace is not None else name
+        return decode(self._routed_request(
+            kind, key,
             {"op": "get", "kind": kind, "name": name,
              "namespace": namespace})["obj"])
 
@@ -539,7 +660,23 @@ class RemoteClusterStore:
 
     def _start_stream(self, subs: Dict[str, List], op: str,
                       replay: bool) -> None:
-        sock = self._connect()
+        endpoints: List[Optional[tuple]] = [None]
+        descs = [""]
+        if self.direct_watch:
+            self._ensure_topology()
+            if self._shard_endpoints:
+                # one stream PER SHARD WORKER, router bypassed: each
+                # worker replays its own objects (their union is the
+                # full replay) and each stream resumes against its own
+                # worker's journal with that shard's marks
+                endpoints = list(self._shard_endpoints)
+                descs = [f"@shard{i}" for i in range(len(endpoints))]
+        for endpoint, suffix in zip(endpoints, descs):
+            self._open_stream(subs, op, replay, endpoint, suffix)
+
+    def _open_stream(self, subs: Dict[str, List], op: str, replay: bool,
+                     endpoint: Optional[tuple], suffix: str) -> None:
+        sock = self._connect(endpoint)
         # register BEFORE the replay loop: close() must be able to unblock
         # a watch() stuck mid-replay on a stalled server
         self._watch_socks.append(sock)
@@ -549,7 +686,8 @@ class RemoteClusterStore:
         # once any frame carries shard structure, switching the resume
         # request from the legacy scalar form to the per-shard map
         state = {"hwm": {}, "sharded": False}
-        desc = kinds[0] if len(kinds) == 1 else f"bulk({','.join(kinds)})"
+        desc = (kinds[0] if len(kinds) == 1
+                else f"bulk({','.join(kinds)})") + suffix
         try:
             self._apply_stream(sock, subs, state, until_synced=True)
         except Exception:
@@ -568,7 +706,8 @@ class RemoteClusterStore:
                     self._drop_watch_sock(cur)
                     if self._closed:
                         return
-                    cur = self._resume_watch(subs, op, state, desc)
+                    cur = self._resume_watch(subs, op, state, desc,
+                                             endpoint)
                     if cur is None:
                         # a resume abandoned because close() landed
                         # mid-attempt is a clean shutdown, not a broken
@@ -670,14 +809,18 @@ class RemoteClusterStore:
                 self._hwm_cv.notify_all()
 
     def _resume_watch(self, subs: Dict[str, List], op: str, state: dict,
-                      desc: str):
+                      desc: str, endpoint: Optional[tuple] = None):
         """Reconnect a broken watch stream with exponential backoff +
         jitter and ask the server to replay from our high-water marks.
         Returns the new streaming socket (mirror already resynced), or
         None when resume is impossible — unknown high-water mark, resume
         window lost server-side (ResumeGapError), or the server stayed
         unreachable past ``watch_resume_window_s`` — in which case the
-        caller falls back to the crash-only contract."""
+        caller falls back to the crash-only contract. A direct per-shard
+        stream resumes against its own worker ``endpoint`` (the
+        supervisor restarts a dead worker on the same port, well inside
+        the resume window); ShardUnavailableError from a router mid-
+        worker-restart keeps backing off the same way."""
         with self._lock:
             if not self.watch_resume or any(
                     not state["hwm"].get(k) for k in subs):
@@ -694,7 +837,7 @@ class RemoteClusterStore:
                          {k: m.get("0", -1)
                           for k, m in state["hwm"].items()})
             try:
-                sock = self._connect()
+                sock = self._connect(endpoint)
                 self._watch_socks.append(sock)
                 send_frame(sock, {"op": op, "kinds": list(subs),
                                   "replay": False, "since": since})
@@ -704,7 +847,11 @@ class RemoteClusterStore:
                 self._drop_watch_sock(sock)
                 log.error("watch stream for %r cannot resume: %s", desc, e)
                 return None
-            except (ConnectionError, OSError, ValueError):
+            except (ConnectionError, OSError, ValueError,
+                    ShardUnavailableError):
+                # ShardUnavailableError: the router refused because the
+                # owning worker is down — transient exactly like an
+                # unreachable server; the supervisor is restarting it
                 self._drop_watch_sock(sock)
                 if time.monotonic() >= deadline:
                     return None

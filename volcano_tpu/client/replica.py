@@ -218,8 +218,8 @@ class _ReplicaHandler(_Handler):
             if min_rv is not None:
                 replica.wait_applied(
                     min_rv, float(req.get("wait_s", DEFAULT_LIST_WAIT_S)))
-            return _Handler._dispatch(store, op, req)
-        return _Handler._dispatch(store, op, req)
+            return _Handler._dispatch(self, store, op, req)
+        return _Handler._dispatch(self, store, op, req)
 
     def _serve_watch(self, sock, store, req) -> None:
         replica = self.server.replica  # type: ignore[attr-defined]

@@ -218,6 +218,16 @@ def recv_frame(sock: socket.socket) -> dict:
     return json.loads(recv_exact(sock, length))
 
 
+def recv_frame_raw(sock: socket.socket) -> bytes:
+    """One frame's payload bytes, unparsed — the multi-process shard
+    router relays worker watch/ship frames verbatim (the workers already
+    stamp shard tags), so the relay never pays a loads/dumps per event."""
+    (length,) = struct.unpack("<I", recv_exact(sock, 4))
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame length {length} exceeds cap")
+    return recv_exact(sock, length)
+
+
 def remote_error(resp: dict) -> Exception:
     """Rebuild a {"ok": false} response (or a bulk_apply per-item error
     entry) as its original exception class, without raising."""
@@ -230,15 +240,16 @@ def raise_remote(resp: dict) -> None:
     raise remote_error(resp)
 
 
-def since_rv(val) -> int:
+def since_rv(val, shard: Optional[int] = None) -> int:
     """A resume high-water mark out of a ``since:`` request: the legacy
     scalar, or the per-shard map ({shard: rv}) a shard-aware client
-    sends — the unsharded server IS shard "0", so it resumes from that
-    entry and ignores the rest (there are none to ignore unless the
-    client migrated from a sharded endpoint, in which case an absent
-    "0" refuses conservatively)."""
+    sends — the unsharded server IS shard "0" (or, for a shard-worker
+    process serving one member lineage, its own ``shard`` index), so it
+    resumes from that entry and ignores the rest (there are none to
+    ignore unless the client migrated from a sharded endpoint, in which
+    case an absent entry refuses conservatively)."""
     if isinstance(val, dict):
-        val = val.get("0", -1)
+        val = val.get(str(shard if shard is not None else 0), -1)
     return int(val if val is not None else -1)
 
 
@@ -382,8 +393,7 @@ class _Handler(socketserver.BaseRequestHandler):
         finally:
             self.server.active.discard(sock)  # type: ignore[attr-defined]
 
-    @staticmethod
-    def _dispatch(store: ClusterStore, op: str, req: dict) -> dict:
+    def _dispatch(self, store: ClusterStore, op: str, req: dict) -> dict:
         kind = req.get("kind")
         # fencing tokens ride the frame; the authoritative store validates
         # them against ITS lease record (the deposed writer's view of its
@@ -443,25 +453,50 @@ class _Handler(socketserver.BaseRequestHandler):
                     "applied_rv": rv}
         if op == "store_info":
             # replica handshake: shape + current rv(s) + whether a WAL
-            # lineage exists to ship
+            # lineage exists to ship. recovered/pid ride along for the
+            # shard-worker supervisor's liveness polls and vcctl status
+            import os as _os
             shards = getattr(store, "shards", None)
             with store.locked():
                 rv = applied_rv_of(store)
             return {"ok": True, "rv": rv,
                     "shards": len(shards) if shards is not None else 1,
                     "durable": getattr(store, "data_dir", None)
-                    is not None}
+                    is not None,
+                    "recovered": getattr(store, "recovered_records", 0),
+                    "pid": _os.getpid()}
         if op == "bootstrap":
             # newest valid on-disk snapshot (replica seed); the WAL
             # records past its rv arrive over the ship stream
             src = _ship_source(store, req.get("shard"))
             rv, state = src.newest_snapshot_state()
             return {"ok": True, "rv": rv, "state": state}
+        if op == "fence_check":
+            # the shard-worker fencing RPC: a worker owning a non-lease
+            # shard validates a write's fencing token against the
+            # arbiter worker's lease record (the ``leases`` bucket is
+            # pinned to shard 0). FencedError re-raises typed
+            # client-side, exactly like a fenced write would.
+            store._check_fence(req.get("fencing") or None)
+            return {"ok": True}
+        if op == "topology":
+            return self._topology(store)
         if op == "ping":
             return {"ok": True}
         if op == "auth":
             return {"ok": True}  # token-less server: auth is a no-op
         raise RuntimeError(f"unknown op {op!r}")
+
+    def _topology(self, store: ClusterStore) -> dict:
+        """The shard map a direct-routing client asks for once: shard
+        count plus per-shard endpoints it may connect to directly. A
+        single-store server (and the in-process ShardRouter, whose
+        shards share its one process) advertises NO direct endpoints —
+        the client then keeps router-only routing. The multi-process
+        router (client/shardproc.py) overrides with real worker
+        endpoints."""
+        shards = getattr(store, "n_shards", 1)
+        return {"ok": True, "n_shards": int(shards), "endpoints": []}
 
     def _serve_watch(self, sock: socket.socket, store: ClusterStore,
                      req: dict) -> None:
@@ -484,6 +519,11 @@ class _Handler(socketserver.BaseRequestHandler):
         # bulk_watch: same subscription semantics, but events coalesce
         # into batched frames (pump_watch) — the high-churn ingest path
         batch_max = WATCH_BATCH_MAX if req.get("op") == "bulk_watch" else 1
+        # a shard-worker process serving ONE member lineage stamps its
+        # shard index into every event/synced frame, so the multi-process
+        # router can relay frames verbatim and a direct-routed client's
+        # per-shard resume marks attribute events without re-tagging
+        shard = getattr(self.server, "shard_tag", None)
         journal: Optional[EventJournal] = getattr(self.server, "journal",
                                                   None)
         # bounded queue + send timeout: a peer that stalls without closing
@@ -507,10 +547,13 @@ class _Handler(socketserver.BaseRequestHandler):
         def listener_for(kind):
             def listener(event, obj, old):
                 # under the store lock: store._rv is this event's rv
-                enqueue({"stream": "event", "kind": kind,
-                         "rv": store._rv, "event": event,
-                         "obj": encode(obj),
-                         "old": encode(old) if old is not None else None})
+                payload = {"stream": "event", "kind": kind,
+                           "rv": store._rv, "event": event,
+                           "obj": encode(obj),
+                           "old": encode(old) if old is not None else None}
+                if shard is not None:
+                    payload["shard"] = shard
+                enqueue(payload)
             return listener
 
         listeners = []
@@ -525,18 +568,21 @@ class _Handler(socketserver.BaseRequestHandler):
             with store.locked():
                 if since is not None:
                     for kind in kinds:
-                        missed = journal.since(kind,
-                                               since_rv(since.get(kind))) \
+                        missed = journal.since(
+                            kind, since_rv(since.get(kind), shard)) \
                             if journal is not None else None
                         if missed is None:
                             gap_kind = kind
                             break
                         for rv, event, obj, old in missed:
-                            enqueue({"stream": "event", "kind": kind,
-                                     "rv": rv, "event": event,
-                                     "obj": encode(obj),
-                                     "old": encode(old)
-                                     if old is not None else None})
+                            payload = {"stream": "event", "kind": kind,
+                                       "rv": rv, "event": event,
+                                       "obj": encode(obj),
+                                       "old": encode(old)
+                                       if old is not None else None}
+                            if shard is not None:
+                                payload["shard"] = shard
+                            enqueue(payload)
                 if gap_kind is None:
                     for kind in kinds:
                         listener = listener_for(kind)
@@ -544,7 +590,9 @@ class _Handler(socketserver.BaseRequestHandler):
                         store.watch(kind, listener,
                                     replay=replay and since is None)
                     enqueue({"stream": "synced",
-                             "rv": {k: store.last_event_rv(k)
+                             "rv": {k: ({str(shard): store.last_event_rv(k)}
+                                        if shard is not None
+                                        else store.last_event_rv(k))
                                     for k in kinds}})
             if gap_kind is not None:
                 send_frame(sock, {

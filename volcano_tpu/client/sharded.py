@@ -594,14 +594,13 @@ class _RouterHandler(_Handler):
     maps against the per-shard journals, and ``bulk_watch`` batches
     events per frame."""
 
-    @staticmethod
-    def _dispatch(store, op: str, req: dict) -> dict:
+    def _dispatch(self, store, op: str, req: dict) -> dict:
         # armed shard_request faults are ConnectionError-shaped: they
         # propagate out of handle()'s request loop and kill this
         # connection the way a dropped shard link would, so the client's
         # transport-retry rules (not its error handling) engage
         faults.fire("shard_request")
-        return _Handler._dispatch(store, op, req)
+        return _Handler._dispatch(self, store, op, req)
 
     def _serve_watch(self, sock, store: ShardedClusterStore,
                      req: dict) -> None:

@@ -422,6 +422,32 @@ store_shard_dropped_total = registry.register(Counter(
     "Events discarded per shard when a condemned (overflowed/stalled) "
     "watch stream was dropped", ["shard"]))
 
+# -- multi-process shard workers (client/shardproc.py) ----------------------
+# set by the ShardProcSupervisor in the router process
+
+store_shard_worker_up = registry.register(Gauge(
+    "volcano_store_shard_worker_up",
+    "1 when the shard's worker process is alive and serving, 0 while "
+    "it is down/restarting (its ops contained with "
+    "ShardUnavailableError)", ["shard"]))
+store_shard_worker_pid = registry.register(Gauge(
+    "volcano_store_shard_worker_pid",
+    "OS pid of the shard's worker process", ["shard"]))
+store_shard_worker_restarts_total = registry.register(Counter(
+    "volcano_store_shard_worker_restarts_total",
+    "Times the supervisor restarted this shard's worker process "
+    "(capped-exponential-backoff respawn on the same port + data dir)",
+    ["shard"]))
+store_shard_worker_uptime_seconds = registry.register(Gauge(
+    "volcano_store_shard_worker_uptime_seconds",
+    "Seconds since the shard's worker process last came READY "
+    "(0 while down)", ["shard"]))
+store_shard_ingest_events_per_sec = registry.register(Gauge(
+    "volcano_store_shard_ingest_events_per_sec",
+    "Committed mutations per second on this shard's worker, sampled "
+    "from its rv progression by the supervisor's liveness polls",
+    ["shard"]))
+
 # -- read replica metrics (client/replica.py) -------------------------------
 
 replica_applied_rv = registry.register(Gauge(

@@ -61,7 +61,14 @@ per routed mutation, and once per touched shard inside a bulk wave —
 arm ``exc:exit`` in a sharded store process to SIGKILL it with some
 shards' sub-batches durable and others not, so recovery must heal every
 per-shard WAL lineage; for killing ONE shard in-process, see
-ShardedClusterStore.crash_shard/recover_shard), ``flatten_event``
+ShardedClusterStore.crash_shard/recover_shard), ``shard_proc_crash``
+(shard WORKER process request dispatch, client/shardproc.py — arm
+``exc:exit`` via the worker's ``--faults`` to SIGKILL exactly that
+worker at its Nth op: the supervisor must restart it with capped
+backoff on the same port + data dir, direct-routed clients must ride
+through on transport retry / router fallback, and watchers must resume
+via ``since:`` against the restarted worker's recovered journal),
+``flatten_event``
 (ops/arrays FlattenCache.feed_event, between observing a mirror delta
 and marking it into the event-sourced flatten ledger — an armed firing
 DROPS the delta exactly as a torn feed would: the observation counter

@@ -64,6 +64,7 @@ class Standalone:
                  store_fsync_interval_s: float = 0.05,
                  store_snapshot_every: int = 4096,
                  store_shards: int = 1,
+                 store_shard_procs: bool = False,
                  controller_shard_workers: int = 1):
         from .cache import SchedulerCache
         from .client import ClusterStore
@@ -72,7 +73,48 @@ class Standalone:
         from .scheduler import Scheduler
         from .webhooks import start_webhooks
 
-        if store_shards > 1:
+        self._shard_supervisor = None
+        if store_shard_procs:
+            # every shard in its OWN OS process (the multi-process
+            # front door, client/shardproc.py): workers own their
+            # lock/rv/journal/WAL lineages AND run the admission chain
+            # at the authoritative store; a thin ProcShardRouter in
+            # this process supervises them and serves one endpoint,
+            # and this process's own consumers (cache, controllers,
+            # scheduler) ride a direct-routing RemoteClusterStore —
+            # single-key traffic bypasses the router like any other
+            # client's.
+            from .client import (
+                ProcShardRouter, ProcShardedStore, RemoteClusterStore,
+                ShardProcSupervisor,
+            )
+            host, port = "127.0.0.1", 0
+            if serve_store:
+                h, _, p = serve_store.rpartition(":")
+                host, port = (h or "127.0.0.1"), int(p)
+            token = store_token if store_token is not None \
+                else os.environ.get("VOLCANO_STORE_TOKEN", "")
+            if not token and host not in ("127.0.0.1", "localhost",
+                                          "::1"):
+                raise ValueError(
+                    f"--serve-store on non-loopback {host!r} requires "
+                    "a shared token (set VOLCANO_STORE_TOKEN)")
+            self._shard_supervisor = ShardProcSupervisor(
+                max(1, store_shards),
+                data_dir=store_data_dir or None,
+                fsync=store_fsync,
+                fsync_interval_s=store_fsync_interval_s,
+                snapshot_every=store_snapshot_every,
+                token=token or None,
+                scheduler_name=scheduler_name,
+                default_queue=default_queue).start()
+            self.store_server = ProcShardRouter(
+                ProcShardedStore(self._shard_supervisor),
+                host, port, token=token or None).start()
+            self.store = RemoteClusterStore(
+                self.store_server.address, token=token or None,
+                direct_watch=True)
+        elif store_shards > 1:
             # the partitioned front door (ROADMAP item 3): N member
             # stores behind deterministic (kind, namespace/name) hash
             # routing, each with its own lock, resume journal and —
@@ -100,10 +142,17 @@ class Standalone:
         # admission interceptors must be installed BEFORE the store starts
         # accepting remote writes, or an early vcctl create slips past the
         # webhook chain (recovery above bypasses admission by design: the
-        # recovered objects were admitted when they first committed)
-        start_webhooks(self.store, scheduler_name=scheduler_name,
-                       default_queue=default_queue)
-        self.store_server = None
+        # recovered objects were admitted when they first committed).
+        # With --store-shard-procs the chain already runs INSIDE each
+        # worker process (the authoritative store); this process is just
+        # another client and must not (and cannot) install interceptors.
+        if self._shard_supervisor is None:
+            start_webhooks(self.store, scheduler_name=scheduler_name,
+                           default_queue=default_queue)
+        else:
+            serve_store = None  # the ProcShardRouter above IS the server
+        if self._shard_supervisor is None:
+            self.store_server = None
         if serve_store:
             # the API-server seam as an actual server: vcctl --server and
             # remote scheduler caches drive this store over TCP
@@ -313,6 +362,8 @@ class Standalone:
         self.metrics_server.stop()
         if self.store_server is not None:
             self.store_server.stop()
+        if self._shard_supervisor is not None:
+            self._shard_supervisor.stop()
         if self.webhook_server is not None:
             self.webhook_server.shutdown()
         close = getattr(self.store, "close", None)
@@ -428,6 +479,16 @@ def main(argv=None) -> int:
                          "through one endpoint speaking the unchanged "
                          "wire protocol. Default 1: the exact "
                          "historical single-store code paths")
+    ap.add_argument("--store-shard-procs", action="store_true",
+                    help="promote each store shard to its OWN OS "
+                         "process (break the GIL): shard workers own "
+                         "their WAL lineages and run admission; a thin "
+                         "router in this process supervises them "
+                         "(capped-backoff restart on the same data "
+                         "dir), serves one endpoint on --serve-store, "
+                         "and publishes the shard map via the "
+                         "'topology' op so clients route single-key "
+                         "ops straight to the owning worker")
     ap.add_argument("--store-replica-of", metavar="HOST:PORT",
                     dest="store_replica_of",
                     help="run as a READ REPLICA of the durable store at "
@@ -583,6 +644,7 @@ def main(argv=None) -> int:
                     store_fsync_interval_s=args.store_fsync_interval,
                     store_snapshot_every=args.store_snapshot_every,
                     store_shards=args.store_shards,
+                    store_shard_procs=args.store_shard_procs,
                     controller_shard_workers=args.controller_shard_workers)
     if args.jobs_dir:
         import glob
