@@ -74,8 +74,9 @@ def main():
                          for k, v in sched.last_cycle_timing.items()})
         return
 
-    lats, host, flat_modes = [], [], []
+    lats, host, flat_modes, order_modes = [], [], [], []
     patch_ms, full_ms = [], []
+    order_ev_ms, order_full_ms = [], []
     for s in range(8):
         for w in range(10):
             make_wave(store, wave)
@@ -96,6 +97,16 @@ def main():
             patch_ms.append(t["flatten_patch_ms"])
         if "flatten_full_ms" in t:
             full_ms.append(t["flatten_full_ms"])
+        # event-sourced ordering trace: the ordering pass's mode, how
+        # many job entries it patched, and its ms split next to the
+        # flatten's (event path vs full-sort fallback)
+        order_modes.append((t.get("order_mode", "?"),
+                            int(t.get("order_entries_patched", 0)),
+                            t.get("order_fallback_reason", "")))
+        if t.get("order_mode") in ("reuse", "event"):
+            order_ev_ms.append(t.get("order_ms", 0.0))
+        elif "order_ms" in t:
+            order_full_ms.append(t["order_ms"])
         sched._maybe_gc()
     print("steady p50", round(float(np.percentile(lats, 50)), 2),
           "host p50", round(float(np.percentile(host, 50)), 2))
@@ -106,6 +117,13 @@ def main():
     print("flatten full ms", [round(x, 2) for x in full_ms],
           "p50", round(float(np.percentile(full_ms, 50)), 2)
           if full_ms else None)
+    print("order modes (mode, patched, fallback):", order_modes)
+    print("order event ms", [round(x, 2) for x in order_ev_ms],
+          "p50", round(float(np.percentile(order_ev_ms, 50)), 2)
+          if order_ev_ms else None)
+    print("order full ms", [round(x, 2) for x in order_full_ms],
+          "p50", round(float(np.percentile(order_full_ms, 50)), 2)
+          if order_full_ms else None)
     print("timing", {k: (round(v, 2) if isinstance(v, float) else v)
                      for k, v in sched.last_cycle_timing.items()})
 

@@ -140,7 +140,15 @@ class AllocateAction(Action):
         """Pending, non-best-effort tasks in task order
         (allocate.go:175-189). ``taskkey`` is the full task-order key
         (resolve once per action via ssn.full_order_key and pass it in for
-        multi-job loops; None falls back to comparator sorting)."""
+        multi-job loops; None falls back to comparator sorting). Jobs
+        unchanged since the OrderCache's last keyed cycle reuse their
+        cached sorted list (version-gated; a mutated or dirty job misses
+        and re-sorts here)."""
+        oc = getattr(ssn, "order_cache", None)
+        if oc is not None:
+            cached = oc.pending_tasks(ssn, job)
+            if cached is not None:
+                return cached
         pending = [
             t for t in job.task_status_index.get(
                 TaskStatus.PENDING, {}).values()
@@ -159,6 +167,28 @@ class AllocateAction(Action):
             out.append(pq.pop())
         return out
 
+    def _collect(self, ssn) -> List:
+        """[(job, sorted pending tasks), ...] in session order: the
+        event-sourced OrderCache when it can serve this conf (patching
+        only event-dirty jobs — O(changes), not O(pending)), else the
+        live comparator walk above. Both produce the identical sequence;
+        the cache degrades itself with a typed reason on anything it
+        cannot prove (ops.ordering)."""
+        oc = getattr(ssn, "order_cache", None)
+        if oc is not None:
+            try:
+                collected = oc.collect(ssn)
+            except Exception:  # noqa: BLE001 — degrade, don't contain
+                log.exception("order cache failed; dropping it and "
+                              "collecting via the live comparator walk")
+                oc.invalidate("order_cache_error")
+                collected = None
+            if collected is not None:
+                return collected
+        taskkey = _task_order_key(ssn)
+        return [(job, self._pending_tasks(ssn, job, taskkey))
+                for job in self._ordered_jobs(ssn)]
+
     # ------------------------------------------------------------------
     # solver mode
     # ------------------------------------------------------------------
@@ -176,9 +206,22 @@ class AllocateAction(Action):
         breaker = getattr(ssn, "breaker", None)
         t0 = _time.perf_counter()
         host_only = ssn.solver_options.get("host_only_jobs") or ()
-        taskkey = _task_order_key(ssn)
         job_order = []
         tasks_in_order = []
+        # the ordering pass: event-sourced when the OrderCache can serve
+        # this conf (O(changes since last cycle)), the live comparator
+        # walk otherwise — surfaced per cycle as order_{mode,ms,
+        # entries_patched,fallback_reason}
+        collected = self._collect(ssn)
+        order_ms = (_time.perf_counter() - t0) * 1e3
+        timing["order_ms"] = order_ms
+        oc = getattr(ssn, "order_cache", None)
+        if oc is not None:
+            timing["order_mode"] = oc.last_mode
+            timing["order_entries_patched"] = \
+                float(oc.last_entries_patched)
+            if oc.last_reason:
+                timing["order_fallback_reason"] = oc.last_reason
         # host-only jobs (GPU sharing, required pod affinity, PVCs) that
         # OUTRANK every device-path job run through the host loop BEFORE
         # the solve, so per-job routing cannot invert priority (a
@@ -187,11 +230,10 @@ class AllocateAction(Action):
         # -sequence still run after — an accepted coarsening of the
         # reference's fully sequential order, noted in the contract.
         pre_host, post_host = [], []
-        for job in self._ordered_jobs(ssn):
+        for job, tasks in collected:
             if job.uid in host_only:
                 (post_host if job_order else pre_host).append(job.uid)
                 continue
-            tasks = self._pending_tasks(ssn, job, taskkey)
             if tasks:
                 job_order.append((job, tasks))
                 tasks_in_order.extend(tasks)

@@ -81,4 +81,11 @@ class EnqueueAction(Action):
                     inqueue = True
             if inqueue:
                 job.pod_group.status.phase = PodGroupPhase.INQUEUE
+                # the flip happens on the session clone AFTER the snapshot
+                # seam ran — an in-session delta the watch-fed ordering
+                # ledger would never see (it changes allocate's
+                # eligibility THIS cycle: Pending-phase jobs are skipped)
+                oc = getattr(ssn, "order_cache", None)
+                if oc is not None:
+                    oc.feed_event("job", "session", job=job.uid)
             queues.push(queue)

@@ -61,16 +61,24 @@ def collect_claimer_jobs(ssn, require_not_pipelined: bool,
         if skip_overused and ssn.overused(queue):
             continue
         jobs = per_queue.get(queue.name)
+        oc = getattr(ssn, "order_cache", None)
         while jobs is not None and not jobs.empty():
             job = jobs.pop()
-            tq = PriorityQueue(ssn.task_order_fn)
-            for t in job.task_status_index.get(
-                    TaskStatus.PENDING, {}).values():
-                if not t.resreq.is_empty():
-                    tq.push(t)
-            tasks = []
-            while not tq.empty():
-                tasks.append(tq.pop())
+            # version-gated reuse of the OrderCache's sorted pending
+            # list: same filter (non-best-effort Pending) and the same
+            # total order (task_order_fn == the full task key), so a job
+            # unchanged since allocate's last keyed cycle skips the
+            # per-task push/pop sort here
+            tasks = oc.pending_tasks(ssn, job) if oc is not None else None
+            if tasks is None:
+                tq = PriorityQueue(ssn.task_order_fn)
+                for t in job.task_status_index.get(
+                        TaskStatus.PENDING, {}).values():
+                    if not t.resreq.is_empty():
+                        tq.push(t)
+                tasks = []
+                while not tq.empty():
+                    tasks.append(tq.pop())
             if tasks:
                 out.append((job, tasks))
     return out

@@ -93,14 +93,24 @@ class PreemptAction(Action):
         self._intra_job(ssn, claimers)
 
     def _intra_job(self, ssn, jobs) -> None:
+        oc = getattr(ssn, "order_cache", None)
         for job in jobs:
-            pq = PriorityQueue(ssn.task_order_fn)
-            for task in job.task_status_index.get(
-                    TaskStatus.PENDING, {}).values():
-                if not task.resreq.is_empty():
-                    pq.push(task)
-            while not pq.empty():
-                preemptor = pq.pop()
+            # same order, two sources: the OrderCache's version-gated
+            # sorted pending list when the job is unchanged since the
+            # last keyed allocate cycle, else the comparator heap (jobs
+            # the solver phase just mutated always take this path)
+            pending = oc.pending_tasks(ssn, job) if oc is not None \
+                else None
+            if pending is None:
+                pq = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(
+                        TaskStatus.PENDING, {}).values():
+                    if not task.resreq.is_empty():
+                        pq.push(task)
+                pending = []
+                while not pq.empty():
+                    pending.append(pq.pop())
+            for preemptor in pending:
                 stmt = ssn.statement()
 
                 def task_filter(task, preemptor=preemptor):

@@ -295,8 +295,15 @@ class SchedulerCache:
         # host cost O(events since last cycle), ~zero on a quiet cluster
         from ..ops.arrays import FlattenCache
         from ..ops.device_cache import PackedDeviceCache
+        from ..ops.ordering import OrderCache
         self.flatten_cache = FlattenCache()
         self.flatten_cache.enable_events()
+        # event-sourced ordering (ops.ordering.OrderCache): the allocate
+        # action's namespace/queue/job/task ordering inputs kept warm
+        # across sessions, fed from the same delta seam as the flatten
+        # ledger below — a cycle's ordering pass patches only event-dirty
+        # jobs instead of re-sorting every pending job/task
+        self.order_cache = OrderCache()
         # separate caches for preempt/reclaim flattens: each action's task
         # set differs from allocate's AND from the other's, and sharing a
         # cache clobbers the wholesale fast-path key every cycle
@@ -409,11 +416,17 @@ class SchedulerCache:
     # -- watch dispatch -----------------------------------------------------
 
     def _feed_flatten(self, kind, event, job=None, node=None):
-        """Forward one typed delta to the event-sourced flatten ledger
-        (no-op for embeddings that run without a flatten cache)."""
+        """Forward one typed delta to the event-sourced flatten AND
+        ordering ledgers (no-op for embeddings that run without the
+        caches). One seam, two consumers: the watch hooks and the
+        version-gated snapshot-clone catch-all below keep both caches'
+        dirty sets complete with a single call site."""
         fc = self.flatten_cache
         if fc is not None:
             fc.feed_event(kind, event, job=job, node=node)
+        oc = self.order_cache
+        if oc is not None:
+            oc.feed_event(kind, event, job=job, node=node)
 
     def _on_pod(self, event, obj, old):
         if obj.scheduler_name == self.scheduler_name:

@@ -94,18 +94,28 @@ class QueueController(Controller):
                 counts["inqueue"] += 1
             else:
                 counts["unknown"] += 1
-        queue.status.pending = counts["pending"]
-        queue.status.running = counts["running"]
-        queue.status.inqueue = counts["inqueue"]
-        queue.status.unknown = counts["unknown"]
-
         desired = queue.spec.state or QueueState.OPEN
         if desired == QueueState.OPEN:
-            queue.status.state = QueueState.OPEN
+            state = QueueState.OPEN
         elif desired == QueueState.CLOSED:
             # closing while podgroups remain (queue/state machine)
-            queue.status.state = (QueueState.CLOSING if has_pgs
-                                  else QueueState.CLOSED)
+            state = QueueState.CLOSING if has_pgs else QueueState.CLOSED
         else:
-            queue.status.state = QueueState.UNKNOWN
+            state = QueueState.UNKNOWN
+
+        st = queue.status
+        if (st.pending, st.running, st.inqueue, st.unknown, st.state) \
+                == (counts["pending"], counts["running"],
+                    counts["inqueue"], counts["unknown"], state):
+            # no-op sync: writing an identical status would churn the
+            # store every controller pass (and re-enqueue this very
+            # queue via our own update event — a self-perpetuating write
+            # loop), which alone keeps a quiet cluster's event-sourced
+            # flatten/ordering from ever reaching their zero-work paths
+            return
+        st.pending = counts["pending"]
+        st.running = counts["running"]
+        st.inqueue = counts["inqueue"]
+        st.unknown = counts["unknown"]
+        st.state = state
         self.cluster.update("queues", queue)

@@ -99,6 +99,12 @@ class Session:
         # of O(n log n) comparator dispatches (solver-mode collection only
         # — the host loop needs live comparators)
         self.order_key_fns: Dict[str, Dict[str, Callable]] = {}
+        # per-plugin key CONTEXT extractors (add_order_key_context_fn):
+        # a key fn that reads state beyond the item itself declares that
+        # outside state here so the cross-session OrderCache can tell when
+        # cached keys of UNCHANGED items went stale (drf: cluster total;
+        # priority: the priority-class table)
+        self.order_key_context_fns: Dict[str, Dict[str, Callable]] = {}
 
         # TPU seam: plugins contribute scalar weights for the on-device
         # scoring families here instead of per-(task,node) callbacks; the
@@ -115,6 +121,10 @@ class Session:
         # path must stand down for this cycle
         self._mutation_ops = 0
         self.flatten_cache = getattr(cache, "flatten_cache", None)
+        # event-sourced ordering inputs (ops.ordering.OrderCache): the
+        # allocate action's collection pass patches only event-dirty jobs;
+        # preempt/reclaim reuse its per-job sorted pending lists
+        self.order_cache = getattr(cache, "order_cache", None)
         self.evict_flatten_caches = getattr(cache, "evict_flatten_caches",
                                             None) or {}
         self.device_cache = getattr(cache, "device_cache", None)
@@ -160,8 +170,22 @@ class Session:
         """Register a sort-key extractor equivalent to plugin ``name``'s
         pairwise comparator in ``registry`` (e.g. "job_order_fns"):
         fn(item) -> value such that comparator(l, r) < 0 iff fn(l) < fn(r).
-        Keys must be static for the duration of a solver-mode collection."""
+        Keys must be static for the duration of a solver-mode collection.
+
+        Cross-session contract (ops.ordering.OrderCache): a key must be a
+        pure function of the item's own version-gated state; a key that
+        also reads anything else (cluster totals, config tables) MUST
+        declare that state via add_order_key_context_fn, or cached orders
+        can go silently stale."""
         self.order_key_fns.setdefault(registry, {})[name] = fn
+
+    def add_order_key_context_fn(self, registry: str, name: str,
+                                 fn: Callable) -> None:
+        """Declare the outside state plugin ``name``'s key extractor in
+        ``registry`` depends on: fn() -> hashable whose value changes
+        whenever that state changes. The OrderCache compares contexts
+        every cycle and falls back to the full sort when any moved."""
+        self.order_key_context_fns.setdefault(registry, {})[name] = fn
 
     def composite_order_key(self, registry: str) -> Optional[Callable]:
         """A key(item) -> tuple covering every active provider of

@@ -314,6 +314,35 @@ flatten_fallbacks_total = registry.register(Counter(
     "mismatch, node_relayout, job_layout, task_count, vocab_growth, "
     "session_mutations, ...)", ["reason"]))
 
+# -- event-sourced ordering metrics (ops.ordering OrderCache) ---------------
+
+order_cycles_total = registry.register(Counter(
+    "volcano_order_cycles_total",
+    "Scheduling-cycle ordering passes by mode: reuse = quiet-cycle walk "
+    "reuse (zero work), event = ledger-driven patch of dirty jobs only, "
+    "full = full keyed re-sort, legacy = comparator-only conf (cache "
+    "stands down)", ["mode"]))
+order_entries_patched = registry.register(Gauge(
+    "volcano_order_entries_patched",
+    "Jobs re-filtered/re-keyed/re-sorted by the last ordering pass; 0 on "
+    "a quiet cluster, the full job count on a fallback cycle"))
+order_entries_patched_total = registry.register(Counter(
+    "volcano_order_entries_patched_total",
+    "Cumulative job entries patched by event-mode ordering passes"))
+order_ms = registry.register(Gauge(
+    "volcano_order_milliseconds",
+    "Wall time of the last EVENT-path ordering pass (reuse or "
+    "dirty-entry patch + index walk)"))
+order_full_ms = registry.register(Gauge(
+    "volcano_order_full_milliseconds",
+    "Wall time of the last full-sort ordering pass (fallback or "
+    "comparator-only collection)"))
+order_fallbacks_total = registry.register(Counter(
+    "volcano_order_fallbacks_total",
+    "Event-path ordering declines into the full sort, by reason (epoch_"
+    "mismatch, conf_reload, key_context, session_mutations, queue_"
+    "membership, comparator_only, ...)", ["reason"]))
+
 # -- resilience metrics (resilience/, scheduler containment, store client) --
 
 breaker_state = registry.register(Gauge(

@@ -8,6 +8,7 @@ jax loads on the first actual solve.
 from .arrays import (  # noqa: F401
     FlattenCache, ScoreParams, SnapshotArrays, bucket, flatten_snapshot,
 )
+from .ordering import OrderCache  # noqa: F401
 
 _LAZY = ("SolveResult", "fits_matrix", "score_matrix", "solve_allocate",
          "solve_allocate_sequential", "solve_allocate_packed",
@@ -21,9 +22,9 @@ _LAZY_PRECOMPILE = ("BucketPrewarmer", "CompileWatcher",
                     "configure_compilation_cache", "watcher")
 _LAZY_PIPELINE = ("SessionPipeline", "SessionTicket", "start_readback")
 
-__all__ = ["FlattenCache", "ScoreParams", "SnapshotArrays", "bucket",
-           "flatten_snapshot", *_LAZY, *_LAZY_EVICT, *_LAZY_DEVCACHE,
-           *_LAZY_PRECOMPILE, *_LAZY_PIPELINE]
+__all__ = ["FlattenCache", "OrderCache", "ScoreParams", "SnapshotArrays",
+           "bucket", "flatten_snapshot", *_LAZY, *_LAZY_EVICT,
+           *_LAZY_DEVCACHE, *_LAZY_PRECOMPILE, *_LAZY_PIPELINE]
 
 
 def __getattr__(name):

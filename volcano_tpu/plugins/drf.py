@@ -394,6 +394,17 @@ class DRFPlugin(Plugin):
         ssn.add_job_order_fn(self.name(), job_order_fn)
         ssn.add_order_key_fn("job_order_fns", self.name(),
                              lambda j: self.job_attrs[j.uid].share)
+        # the share key is live-share-dependent: share = f(job.allocated,
+        # cluster total). job.allocated churn is version-gated (the
+        # OrderCache re-keys dirty jobs), but the TOTAL is cluster-wide
+        # state — declare it as the key's context so a node add/remove/
+        # respec invalidates every cached share-ordered position instead
+        # of silently re-ranking only the churned jobs
+        total = self.total_resource
+        ssn.add_order_key_context_fn(
+            "job_order_fns", self.name(),
+            lambda: (total.milli_cpu, total.memory,
+                     tuple(sorted(total.scalars.items()))))
 
         if namespace_order:
             def namespace_order_fn(l, r):

@@ -30,6 +30,22 @@ class PriorityPlugin(Plugin):
         ssn.add_job_order_fn(self.name(), job_order_fn)
         ssn.add_order_key_fn("job_order_fns", self.name(),
                              lambda j: -j.priority)
+        # JobInfo.priority is resolved from the priority-class table at
+        # every snapshot WITHOUT bumping the job's version, so the key is
+        # not a pure function of the job clone: declare the table as the
+        # key's context so cached orders go stale when a class is edited.
+        # (Task priority needs no context — pods carry their admission-
+        # resolved value.)
+        cache = getattr(ssn, "cache", None)
+
+        def _pclass_context():
+            pcs = getattr(cache, "priority_classes", None) or {}
+            return (getattr(cache, "default_priority", 0),
+                    tuple(sorted((n, getattr(pc, "value", 0))
+                                 for n, pc in pcs.items())))
+
+        ssn.add_order_key_context_fn("job_order_fns", self.name(),
+                                     _pclass_context)
 
         def preemptable_fn(preemptor, preemptees):
             """Victims must belong to strictly lower-priority jobs."""

@@ -77,6 +77,14 @@ check detects the skew and falls back to the full re-diff instead of
 assembling from a stale layout), and ``flatten_event_dup`` (same seam,
 after the mark — an armed firing applies the delta a second time,
 skewing the epoch the other way; detection and fallback are identical),
+``order_event`` (ops/ordering OrderCache.feed_event, between observing
+a mirror delta and marking it into the event-sourced ORDERING ledger —
+an armed firing DROPS the delta; the next allocate collection's
+consistency-epoch check detects the skew and falls back to the full
+namespace/queue/job/task sort instead of walking a stale index), and
+``order_event_dup`` (same seam, after the mark — the delta applies
+twice, skewing the epoch the other way; detection and fallback are
+identical),
 ``wal_ship`` (client/server.py _serve_ship, at every segment-stream
 frame send — arm ``exc:`` to drop the link mid-segment so the replica
 must resume at a record boundary, ``exc:exit`` to SIGKILL the primary

@@ -308,6 +308,27 @@ class Scheduler:
             if reason:
                 metrics.flatten_fallbacks_total.inc(
                     labels={"reason": str(reason)})
+        # event-sourced ordering accounting (ops.ordering OrderCache):
+        # same shape as the flatten family — which path the cycle's
+        # ordering pass took, how many job entries it patched, the
+        # event-vs-full latency split, and the typed fallback counters
+        ocache = getattr(self.cache, "order_cache", None)
+        if ocache is not None and "order_mode" in timing:
+            mode = timing["order_mode"]
+            metrics.order_cycles_total.inc(labels={"mode": mode})
+            patched = timing.get("order_entries_patched", 0.0)
+            metrics.order_entries_patched.set(patched)
+            if patched and mode == "event":
+                metrics.order_entries_patched_total.inc(patched)
+            if "order_ms" in timing:
+                if mode in ("reuse", "event"):
+                    metrics.order_ms.set(timing["order_ms"])
+                else:
+                    metrics.order_full_ms.set(timing["order_ms"])
+            reason = timing.get("order_fallback_reason")
+            if reason:
+                metrics.order_fallbacks_total.inc(
+                    labels={"reason": str(reason)})
         from .ops.precompile import watcher
         c, s = watcher.session_totals()
         prev_c, prev_s = self._compile_totals
