@@ -77,6 +77,16 @@ reports scheduler cycle stretch per arm, read-tier events/sec, and
 replica apply lag (records, p50/p99) — ``ok`` enforces stretch <= 1.05x
 idle with the storm on one replica.
 
+``overload_shed`` is the admission-layer acceptance run (ISSUE 15): the
+read_replica_fanout storm rig (200 watchers + list storm) aimed AT the
+primary, against an ungated front door (the PR-12 collapse, writers
+~20x down) and a gated one (read lane bounded at 8:64:16 — the storm
+sheds TYPED at the gate while bulk-lane writers and control-lane
+scheduler traffic pass); ``ok`` enforces gated writers >= 10x the
+ungated floor and >= 300 events/sec, zero system-lane sheds, every
+storm refusal a typed OverloadedError with a retry-after hint, and
+binds identical to an unloaded golden.
+
 ``cycle_start_scale`` is the event-sourced ordering acceptance run
 (ISSUE 14): two identical live-Scheduler rigs over a 10k-pending-task /
 1k-job backlog run the same seeded churn script, one with the
@@ -2914,6 +2924,280 @@ def read_replica_fanout():
     return out
 
 
+def overload_shed():
+    """The overload-protection acceptance config (ISSUE 15): the
+    ``read_replica_fanout`` storm rig — 200 watchers + a list storm
+    (tests/watch_storm_proc.py) aimed straight AT the primary, two
+    bulk-lane writer processes churning, a live paced Scheduler in the
+    driver — run against three primaries: ``golden`` (gate at defaults,
+    NO storm: the bind baseline), ``ungated_storm`` (admission gate
+    disabled — the pre-overload front door; PR 12 recorded writers
+    collapsing ~20x to 29 events/sec here), and ``gated_storm``
+    (read lane bounded at 8 inflight / 64 queued / 16 live streams:
+    the storm sheds TYPED at the gate while bulk writers, control-lane
+    scheduler traffic and system-lane work pass untouched).
+
+    ``ok`` enforces the ISSUE bounds: gated writers sustain >= 10x the
+    ungated collapse floor AND >= 300 events/sec (both floors move into
+    ``core_bound`` on rigs without >= 4 cores, the PR-14 honesty rule —
+    the storm processes must not share the scheduler's core for the
+    absolute number to mean anything); ``system``-lane sheds == 0
+    across the run; every storm-side refusal is a typed OverloadedError
+    with a retry-after hint (zero untyped list errors, watchers either
+    admitted or shed typed — zero hangs, zero silent drops); and the
+    scheduler's decisions stay bind-for-bind identical to the unloaded
+    golden."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+    TESTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tests")
+    sys.path.insert(0, TESTS)
+    from durable_soak import free_port, start_store_proc
+    from helpers import build_node, build_pod, build_pod_group, build_queue
+    from volcano_tpu.client import RemoteClusterStore
+
+    WATCHERS = 200
+    LIST_THREADS = 4
+    WRITERS, WAVES, WAVE = 2, 1, 300   # 1200 churn events per arm
+    GATED_LANES = "read=8:64:16"
+
+    def pct(ms, q):
+        return round(float(np.percentile(ms, q)), 2) if ms else None
+
+    def wait_ready(proc, what):
+        deadline = time.time() + 60
+        line = ""
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("READY"):
+                return line.split()
+            if proc.poll() is not None:
+                break
+        raise RuntimeError(f"{what} failed to start: {line!r}")
+
+    def one_arm(label, storm, gated, disabled=False):
+        from volcano_tpu.cache import (
+            FakeEvictor, RecordingBinder, SchedulerCache,
+        )
+        from volcano_tpu.scheduler import Scheduler
+
+        work = tempfile.mkdtemp(prefix="volcano-overload-bench-")
+        pport = free_port()
+        server = start_store_proc(
+            pport, os.path.join(work, "pdata"), fsync="off",
+            admission_lanes=GATED_LANES if gated else None,
+            admission_disabled=disabled)
+        addr = f"127.0.0.1:{pport}"
+        arm = {"label": label, "storm": storm, "gated": gated,
+               "ungated": disabled}
+        clients = []
+        procs = [server]
+
+        def client(a=addr, **kw):
+            c = RemoteClusterStore(a, **kw)
+            clients.append(c)
+            return c
+
+        try:
+            # -- seed + scheduler (control-lane client, like a real
+            # control plane's own traffic) ------------------------------
+            seed = client(lane="control")
+            seed.apply("queues", build_queue("q0", weight=1))
+            for i in range(8):
+                seed.apply("nodes", build_node(
+                    f"n{i}", {"cpu": "32", "memory": "128Gi"}))
+            for j in range(4):
+                seed.apply("podgroups", build_pod_group(
+                    f"job{j}", "bench", min_member=2, queue="q0"))
+                for i in range(2):
+                    seed.create("pods", build_pod(
+                        "bench", f"job{j}-{i}", "", "Pending",
+                        {"cpu": "1", "memory": "1Gi"}, f"job{j}"))
+            cache = SchedulerCache(client(lane="control"))
+            cache.evictor = FakeEvictor()
+            recorder = RecordingBinder(inner=cache.binder)
+            cache.binder = recorder
+            cache.run()
+            cache.wait_for_cache_sync()
+            sched = Scheduler(cache)
+            sched.run_once()  # warm-up: compiles + binds the workload
+            idle = []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                sched.run_once()
+                idle.append((time.perf_counter() - t0) * 1e3)
+            arm["cycle_p50_idle_ms"] = pct(idle, 50)
+
+            # -- the storm, aimed at the primary ------------------------
+            storms = []
+            if storm:
+                sp = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(TESTS, "watch_storm_proc.py"),
+                     "--addr", addr, "--watchers", str(WATCHERS),
+                     "--list-threads", str(LIST_THREADS)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True, cwd=os.path.dirname(TESTS))
+                ready = wait_ready(sp, "watch storm")
+                arm["watchers_live"] = int(ready[1])
+                arm["watch_sheds"] = int(ready[2])
+                procs.append(sp)
+                storms.append(sp)
+
+            writers = []
+            for w in range(WRITERS):
+                wp = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(TESTS, "store_churn_proc.py"),
+                     "--addr", addr, "--writer", str(w),
+                     "--waves", str(WAVES), "--wave-size", str(WAVE)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True, cwd=os.path.dirname(TESTS))
+                wait_ready(wp, f"writer {w}")
+                procs.append(wp)
+                writers.append(wp)
+
+            under = []
+            stop = threading.Event()
+
+            def cycles():
+                # paced like a real scheduler period
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        sched.run_once()
+                    except Exception:  # noqa: BLE001 — stretch data only
+                        break
+                    under.append((time.perf_counter() - t0) * 1e3)
+                    stop.wait(0.05)
+
+            cyc = threading.Thread(target=cycles)
+            cyc.start()
+            t0 = time.perf_counter()
+            for sp in storms:
+                sp.stdin.write("GO\n")
+                sp.stdin.flush()
+            for wp in writers:
+                wp.stdin.write("GO\n")
+                wp.stdin.flush()
+            applied = 0
+            for wp in writers:
+                parts = wp.stdout.readline().split()
+                applied += int(parts[1])
+                wp.wait(timeout=120)
+            churn_s = time.perf_counter() - t0
+            time.sleep(0.3)
+            stop.set()
+            cyc.join()
+
+            for sp in storms:
+                sp.stdin.write("STOP\n")
+                sp.stdin.flush()
+                parts = sp.stdout.readline().split()
+                arm["read_tier_events"] = int(parts[1])
+                arm["lists_done"] = int(parts[2])
+                arm["list_errors"] = int(parts[3])
+                arm["list_sheds"] = int(parts[4])
+                arm["watch_sheds"] = int(parts[5])
+                arm["watchers_live"] = int(parts[6])
+                sp.wait(timeout=30)
+
+            arm["churn_events_applied"] = applied
+            arm["churn_s"] = round(churn_s, 2)
+            arm["writer_events_per_sec"] = round(applied / churn_s)
+            arm["cycle_p50_storm_ms"] = pct(under, 50)
+            arm["cycle_stretch"] = (
+                round(arm["cycle_p50_storm_ms"]
+                      / arm["cycle_p50_idle_ms"], 3)
+                if under and arm["cycle_p50_idle_ms"] else None)
+            arm["binds"] = sorted(recorder.binds.items())
+
+            # the primary's own admission table: what shed, in which
+            # lane, for which reason — and that system shed NOTHING
+            try:
+                info = client().admission_info()
+                lanes = info.get("lanes") or {}
+                arm["admission_enabled"] = bool(info.get("enabled"))
+                arm["admission"] = {
+                    lane: {"admitted": st["admitted"],
+                           "sheds": st["sheds"],
+                           "shed_reasons": st["shed_reasons"],
+                           "deadline_expired": st["deadline_expired"]}
+                    for lane, st in lanes.items()}
+                arm["system_sheds"] = (lanes.get("system") or {}).get(
+                    "sheds", 0)
+            except Exception as e:  # noqa: BLE001 — recorded honestly
+                arm["admission_error"] = f"{type(e).__name__}: {e}"
+            return arm
+        finally:
+            for c in clients:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            for proc in procs:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            shutil.rmtree(work, ignore_errors=True)
+
+    out = {"arms": {}, "cpu_count": os.cpu_count(),
+           "gated_lanes": GATED_LANES, "watchers": WATCHERS}
+    for label, storm, gated, disabled in (
+            ("golden", False, False, False),
+            ("ungated_storm", True, False, True),
+            ("gated_storm", True, True, False)):
+        out["arms"][label] = _run_config(
+            f"overload_shed[{label}]",
+            lambda s=storm, g=gated, d=disabled, la=label:
+            one_arm(la, s, g, d))
+    golden = out["arms"].get("golden", {})
+    ungated = out["arms"].get("ungated_storm", {})
+    gated = out["arms"].get("gated_storm", {})
+
+    g_eps = gated.get("writer_events_per_sec") or 0
+    u_eps = ungated.get("writer_events_per_sec") or 0
+    out["writer_eps_ungated"] = u_eps
+    out["writer_eps_gated"] = g_eps
+    out["writer_relief"] = round(g_eps / u_eps, 2) if u_eps else None
+    out["binds_identical_to_golden"] = bool(
+        golden.get("binds") and gated.get("binds") == golden.get("binds"))
+    out["system_sheds"] = gated.get("system_sheds")
+    # zero hangs, zero silent drops: every storm-side refusal was a
+    # typed OverloadedError (watchers admitted or shed typed; list
+    # refusals typed; no untyped errors)
+    out["all_sheds_typed"] = bool(
+        gated.get("list_errors", 1) == 0
+        and (gated.get("watchers_live", 0)
+             + gated.get("watch_sheds", 0)) == WATCHERS)
+    # ISSUE floors; core-bound honesty per the PR-14 rule — on a rig
+    # where storm + writers + scheduler share one core, the absolute
+    # and relative throughput floors measure the core, not the gate
+    floors = {
+        "floor_gated_eps": 300,
+        "floor_relief_x": 10.0,
+        "gated_eps": g_eps,
+        "relief_x": out["writer_relief"],
+        "met": bool(g_eps >= 300 and u_eps and g_eps >= 10 * u_eps),
+    }
+    capable_rig = (out["cpu_count"] or 1) >= 4
+    out["core_bound"] = None if (capable_rig or floors["met"]) \
+        else dict(floors)
+    out["ok"] = bool(
+        out["binds_identical_to_golden"]
+        and gated.get("system_sheds") == 0
+        and out["all_sheds_typed"]
+        and gated.get("admission_enabled")
+        and (floors["met"] or not capable_rig))
+    out["floors"] = floors
+    return out
+
+
 def _transient_markers():
     """Shared with the in-scheduler dispatch retry
     (volcano_tpu.resilience.transient) so both layers agree on what
@@ -2982,6 +3266,7 @@ def _main_inner() -> dict:
         ("store_durability", store_durability),
         ("store_shard_scale", store_shard_scale),
         ("read_replica_fanout", read_replica_fanout),
+        ("overload_shed", overload_shed),
     ):
         configs[name] = _run_config(name, fn)
     setup_s = time.time() - t_setup

@@ -44,7 +44,9 @@ def start_store_proc(port: int, data_dir: str, fsync: str = "every",
                      timeout: float = 60.0,
                      shards: int = 1,
                      shard_procs: bool = False,
-                     worker_faults=None) -> subprocess.Popen:
+                     worker_faults=None,
+                     admission_lanes=None,
+                     admission_disabled: bool = False) -> subprocess.Popen:
     """Launch store_server_proc.py and wait for its READY line."""
     cmd = [sys.executable, os.path.join(TESTS_DIR, "store_server_proc.py"),
            "--port", str(port), "--data-dir", data_dir,
@@ -54,6 +56,10 @@ def start_store_proc(port: int, data_dir: str, fsync: str = "every",
         cmd.append("--shard-procs")
     if worker_faults:
         cmd += ["--worker-faults", worker_faults]
+    if admission_lanes:
+        cmd += ["--admission-lanes", admission_lanes]
+    if admission_disabled:
+        cmd.append("--admission-disabled")
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,

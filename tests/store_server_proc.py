@@ -47,13 +47,32 @@ def main() -> int:
                     help="fault spec armed in every shard WORKER "
                          "process (e.g. shard_proc_crash=at:40,"
                          "exc:exit)")
+    ap.add_argument("--admission-lanes", default=None,
+                    help="per-lane admission bounds "
+                         "(lane=inflight[:queue[:streams]],...) for "
+                         "this server's gate; default = generous "
+                         "fail-safe limits")
+    ap.add_argument("--admission-queue-wait-ms", type=float,
+                    default=None)
+    ap.add_argument("--admission-disabled", action="store_true",
+                    help="serve UNGATED (the pre-overload front door; "
+                         "the overload_shed bench's collapse arm)")
     args = ap.parse_args()
 
     from volcano_tpu.client import DurableClusterStore, StoreServer
     from volcano_tpu.resilience import faults
+    from volcano_tpu.resilience.overload import (
+        AdmissionGate, parse_lane_spec,
+    )
 
     if args.faults:
         faults.configure(args.faults)
+
+    gate_kw = {}
+    if args.admission_queue_wait_ms is not None:
+        gate_kw["queue_wait_ms"] = args.admission_queue_wait_ms
+    gate = AdmissionGate(parse_lane_spec(args.admission_lanes),
+                         enabled=not args.admission_disabled, **gate_kw)
 
     if args.shard_procs:
         from volcano_tpu.client import (
@@ -63,24 +82,27 @@ def main() -> int:
             max(1, args.shards), data_dir=args.data_dir or None,
             fsync=args.fsync, snapshot_every=args.snapshot_every,
             admission=False, worker_faults=args.worker_faults,
+            admission_lanes=args.admission_lanes,
+            admission_queue_wait_ms=args.admission_queue_wait_ms,
             restart_backoff_base_s=0.1).start()
         store = ProcShardedStore(sup)
-        server = ProcShardRouter(store, port=args.port).start()
+        server = ProcShardRouter(store, port=args.port,
+                                 gate=gate).start()
     elif args.shards > 1:
         from volcano_tpu.client import ShardedClusterStore, ShardRouter
         store = ShardedClusterStore(args.shards,
                                     data_dir=args.data_dir or None,
                                     fsync=args.fsync,
                                     snapshot_every=args.snapshot_every)
-        server = ShardRouter(store, port=args.port).start()
+        server = ShardRouter(store, port=args.port, gate=gate).start()
     elif args.data_dir:
         store = DurableClusterStore(args.data_dir, fsync=args.fsync,
                                     snapshot_every=args.snapshot_every)
-        server = StoreServer(store, port=args.port).start()
+        server = StoreServer(store, port=args.port, gate=gate).start()
     else:
         from volcano_tpu.client import ClusterStore
         store = ClusterStore()
-        server = StoreServer(store, port=args.port).start()
+        server = StoreServer(store, port=args.port, gate=gate).start()
     print(f"READY {server.port} rv={store._rv} "
           f"recovered={getattr(store, 'recovered_records', 0)}",
           flush=True)

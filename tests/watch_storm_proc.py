@@ -1,13 +1,21 @@
-"""Read-tier load generator for the ``read_replica_fanout`` bench: N
-watch streams + M list-storm threads against one store endpoint
-(primary or replica), in THEIR OWN process so the fan-out cost never
-shares the driver's (or the server's) GIL — the same
-separate-processes-are-the-point rule as store_churn_proc.py.
+"""Read-tier load generator for the ``read_replica_fanout`` and
+``overload_shed`` benches: N watch streams + M list-storm threads
+against one store endpoint (primary or replica), in THEIR OWN process
+so the fan-out cost never shares the driver's (or the server's) GIL —
+the same separate-processes-are-the-point rule as store_churn_proc.py.
 
-Prints ``READY`` once every watch stream is subscribed, waits for
-``GO`` on stdin, storms until ``STOP`` arrives (list threads loop,
-watchers count deliveries), then prints
-``DONE <events_seen> <lists_done> <list_errors>``."""
+Overload-aware: a watcher refused at the admission gate
+(OverloadedError) is COUNTED as a typed shed, not an error — the gate
+shedding a storm typed is the behavior under test — and list threads
+count typed sheds separately from real errors, sleeping out the
+server's retry-after hint before pressing again.
+
+Prints ``READY <watchers_live> <watch_sheds>`` once every watch
+subscription has been answered (admitted or shed typed), waits for
+``GO`` on stdin, storms until ``STOP`` arrives, then prints
+``DONE <events_seen> <lists_done> <list_errors> <list_sheds>
+<watch_sheds> <watchers_live>`` — the first four fields keep their
+historical positions."""
 
 import argparse
 import os
@@ -27,10 +35,12 @@ def main() -> int:
     ap.add_argument("--namespace", default="churn")
     args = ap.parse_args()
 
-    from volcano_tpu.client import RemoteClusterStore
+    from volcano_tpu.client import OverloadedError, RemoteClusterStore
 
     client = RemoteClusterStore(args.addr, connect_timeout=10.0)
     seen = [0]
+    watch_sheds = [0]
+    watchers_live = [0]
     lock = threading.Lock()
 
     def on_pod(event, obj, old):
@@ -38,22 +48,39 @@ def main() -> int:
             seen[0] += 1
 
     for _ in range(args.watchers):
-        client.watch("pods", on_pod, replay=False)
-    print("READY", flush=True)
+        try:
+            client.watch("pods", on_pod, replay=False)
+            watchers_live[0] += 1
+        except OverloadedError as e:
+            # typed shed with a retry-after hint: the gate bounding
+            # live fan-out is exactly the behavior the bench measures
+            watch_sheds[0] += 1
+            if e.retry_after_ms:
+                time.sleep(min(float(e.retry_after_ms) / 1000.0, 0.05))
+    print(f"READY {watchers_live[0]} {watch_sheds[0]}", flush=True)
     if sys.stdin.readline().strip() != "GO":
         return 1
 
     stop = threading.Event()
     lists = [0]
     list_errors = [0]
+    list_sheds = [0]
 
     def list_storm():
-        lister = RemoteClusterStore(args.addr, connect_timeout=10.0)
+        lister = RemoteClusterStore(args.addr, connect_timeout=10.0,
+                                    retry_attempts=1, retry_base_s=0.05)
         while not stop.is_set():
             try:
                 lister.list("pods", namespace=args.namespace)
                 with lock:
                     lists[0] += 1
+            except OverloadedError as e:
+                # typed refusal (incl. RetryBudgetExhausted): honor the
+                # hint instead of hammering the shedding server
+                with lock:
+                    list_sheds[0] += 1
+                time.sleep(max(0.05,
+                               float(e.retry_after_ms or 0) / 1000.0))
             except Exception:  # noqa: BLE001 — counted, not fatal
                 with lock:
                     list_errors[0] += 1
@@ -69,7 +96,8 @@ def main() -> int:
     for t in threads:
         t.join(timeout=10)
     client.close()
-    print(f"DONE {seen[0]} {lists[0]} {list_errors[0]}", flush=True)
+    print(f"DONE {seen[0]} {lists[0]} {list_errors[0]} {list_sheds[0]} "
+          f"{watch_sheds[0]} {watchers_live[0]}", flush=True)
     return 0
 
 

@@ -323,10 +323,39 @@ def queue_delete(args, cluster: ClusterStore) -> str:
 # status command (store topology + shard-worker liveness)
 # ---------------------------------------------------------------------------
 
+def _admission_table(lanes: dict) -> str:
+    """Per-lane admission rows (resilience/overload.py stats shape)."""
+    rows = []
+    for lane in ("system", "control", "bulk", "read"):
+        st = lanes.get(lane)
+        if st is None:
+            continue
+        caps = "/".join(
+            "inf" if not st.get(k) else str(st.get(k))
+            for k in ("max_inflight", "max_queue", "max_streams"))
+        reasons = st.get("shed_reasons") or {}
+        rows.append([
+            lane,
+            str(st.get("inflight", 0)), str(st.get("streams", 0)),
+            str(st.get("queued", 0)), str(st.get("admitted", 0)),
+            str(st.get("sheds", 0)),
+            str(st.get("deadline_expired", 0)),
+            ",".join(f"{k}:{v}" for k, v in sorted(reasons.items()))
+            or "-",
+            caps,
+        ])
+    return _table(
+        ["Lane", "Inflight", "Streams", "Queued", "Admitted", "Sheds",
+         "DeadlineExp", "ShedReasons", "Limits(i/q/s)"], rows)
+
+
 def status_cmd(args, cluster: ClusterStore) -> str:
-    """Control-plane store status: shape, durability, rv(s) — and, for
-    a multi-process sharded deployment, the shard map with per-worker
-    endpoint, liveness, pid, restart count, uptime and ingest rate."""
+    """Control-plane store status: shape, durability, rv(s) — for a
+    multi-process sharded deployment, the shard map with per-worker
+    endpoint, liveness, pid, restart count, uptime and ingest rate —
+    and the overload-admission lane table (inflight / queued / sheds /
+    deadline expirations per lane; works against plain, sharded, proc
+    and replica endpoints alike)."""
     req = getattr(cluster, "_request", None)
     if req is None:
         shards = getattr(cluster, "n_shards", 1)
@@ -367,6 +396,31 @@ def status_cmd(args, cluster: ClusterStore) -> str:
             + "\n(shards share the server process; no direct endpoints)")
     else:
         lines.append(f"rv: {rv}")
+    try:
+        adm = req({"op": "admission_info"})
+    except Exception:  # noqa: BLE001 — pre-admission (old) server
+        adm = None
+    if adm and adm.get("enabled"):
+        lines.append("admission (front-door lanes):")
+        lines.append(_admission_table(adm.get("lanes") or {}))
+        worker_lanes = adm.get("workers") or {}
+        for shard in sorted(worker_lanes, key=lambda s: int(s)):
+            wl = worker_lanes[shard]
+            if not wl:
+                lines.append(f"admission shard {shard}: (worker down)")
+                continue
+            sheds = sum(st.get("sheds", 0) for st in wl.values())
+            if sheds:
+                lines.append(f"admission shard {shard} "
+                             f"(worker gate, {sheds} sheds):")
+                lines.append(_admission_table(wl))
+        if worker_lanes and not any(
+                sum(st.get("sheds", 0) for st in (wl or {}).values())
+                for wl in worker_lanes.values()):
+            lines.append(f"(each of the {len(worker_lanes)} shard "
+                         "workers runs its own gate; no worker sheds)")
+    elif adm is not None:
+        lines.append("admission: gate disabled")
     return "\n".join(lines)
 
 

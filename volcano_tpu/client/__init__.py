@@ -1,8 +1,12 @@
 """Cluster store: the API-server/informer seam (in-memory + over TCP),
 plus the optional WAL/snapshot durability layer behind it, the sharded
-front door (partitioned store + one-endpoint router), and the
-WAL-shipped read-replica tier."""
+front door (partitioned store + one-endpoint router), the WAL-shipped
+read-replica tier, and the overload-protected admission layer every
+server consults before dispatch (resilience/overload.py)."""
 
+from ..resilience.overload import (  # noqa: F401
+    AdmissionGate, OverloadedError, RetryBudget, RetryBudgetExhausted,
+)
 from .durable import DurableClusterStore, WriteAheadLog  # noqa: F401
 from .remote import RemoteClusterStore  # noqa: F401
 from .replica import (  # noqa: F401

@@ -30,6 +30,10 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..resilience.faultinject import faults
+from ..resilience.overload import (
+    OverloadedError, RetryBudget, RetryBudgetExhausted, classify,
+    current_lane,
+)
 from .codec import decode, encode
 from .server import MAGIC, raise_remote, recv_frame, remote_error, send_frame
 from .sharded import shard_for
@@ -100,7 +104,10 @@ class RemoteClusterStore:
                  watch_backoff_cap_s: float = 2.0,
                  pool_size: int = 1,
                  direct_routing: bool = True,
-                 direct_watch: bool = False):
+                 direct_watch: bool = False,
+                 lane: Optional[str] = None,
+                 op_deadline_ms: float = 0.0,
+                 retry_budget: Optional[RetryBudget] = None):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -180,6 +187,21 @@ class RemoteClusterStore:
         self._watch_socks: List[socket.socket] = []
         self._closed = False
         self._stop_event = threading.Event()  # wakes backoff sleeps
+        # -- overload protection (resilience/overload.py) ---------------
+        # every request carries additive prio/client headers (and, with
+        # op_deadline_ms set, a deadline_ms header the server enforces);
+        # old servers ignore unknown fields, so interop is unchanged.
+        # ``lane`` is this client's default classification — strong
+        # classifications (fenced => system, leases => system, bulk
+        # waves => bulk) always win over it.
+        self.lane = lane
+        self.op_deadline_ms = float(op_deadline_ms or 0.0)
+        self.retry_budget = retry_budget if retry_budget is not None \
+            else RetryBudget()
+        import uuid
+        self.client_id = uuid.uuid4().hex[:12]  # flow-fairness identity
+        self.overload_retries = 0      # Overloaded responses retried
+        self.overload_sheds_seen = 0   # OverloadedError surfaced typed
 
     # -- plumbing -----------------------------------------------------------
 
@@ -318,8 +340,75 @@ class RemoteClusterStore:
         self.direct_requests += 1
         return resp
 
+    def _classify(self, payload: dict) -> str:
+        """Lane for one request: the strong classifications (fenced
+        write / lease traffic => system, bulk wave => bulk) win; then
+        any ambient LaneStore hint or this client's default lane; then
+        op shape (see resilience/overload.classify)."""
+        return classify(payload.get("op"), kind=payload.get("kind"),
+                        fencing=payload.get("fencing"),
+                        prio=payload.get("prio") or current_lane()
+                        or self.lane)
+
     def _request(self, payload: dict,
                  endpoint: Optional[tuple] = None) -> dict:
+        """One request with the full client-side overload discipline on
+        top of the transport layer (_request_once): stamp the additive
+        ``prio``/``client`` headers (and ``deadline_ms`` when a per-op
+        budget is configured), and on a typed Overloaded shed HONOR the
+        server's retry-after hint — but cap retries with the global
+        retry budget (~10% of recent request volume) so a shedding
+        server never faces a retry storm that amplifies the outage.
+        ``system``-lane ops (lease renewal, fenced writes) bypass the
+        budget: giving up on the lease IS the outage."""
+        lane = self._classify(payload)
+        payload.setdefault("prio", lane)
+        payload.setdefault("client", self.client_id)
+        budget_ms = self.op_deadline_ms
+        t0 = time.monotonic() if budget_ms else 0.0
+        delay = self.retry_base_s
+        attempt = 0
+        while True:
+            if budget_ms:
+                left = budget_ms - (time.monotonic() - t0) * 1e3
+                if left <= 0:
+                    raise OverloadedError(
+                        f"op {payload.get('op')!r} deadline "
+                        f"({budget_ms:.0f}ms) exhausted client-side "
+                        "across retries", lane=lane, reason="deadline")
+                payload["deadline_ms"] = round(left, 1)
+            self.retry_budget.on_request()
+            resp = self._request_once(payload, endpoint)
+            if resp.get("ok") is False \
+                    and resp.get("error") == "OverloadedError":
+                err = remote_error(resp)
+                attempt += 1
+                with self._lock:
+                    self.overload_sheds_seen += 1
+                if attempt > self.retry_attempts or self._closed:
+                    raise err
+                if lane != "system" and not self.retry_budget.try_spend():
+                    raise RetryBudgetExhausted(
+                        f"retry budget exhausted after a shed "
+                        f"(lane {err.lane!r}, reason {err.reason!r}): "
+                        f"{err}", retry_after_ms=err.retry_after_ms,
+                        lane=err.lane, reason="retry_budget")
+                with self._lock:
+                    self.overload_retries += 1
+                wait = delay
+                if err.retry_after_ms:
+                    # the server's hint is the floor: it knows how long
+                    # its queues need to drain better than our backoff
+                    wait = max(wait, float(err.retry_after_ms) / 1000.0)
+                self._stop_event.wait(wait * (0.5 + random.random()))
+                delay = min(delay * 2.0, self.retry_cap_s)
+                continue
+            if not resp.get("ok"):
+                raise_remote(resp)
+            return resp
+
+    def _request_once(self, payload: dict,
+                      endpoint: Optional[tuple] = None) -> dict:
         # Retry rules: a failed SEND is always safe to retry (the server
         # only acts on complete frames, and a broken connection can never
         # complete a partial one). A failure AFTER the send is ambiguous —
@@ -389,8 +478,6 @@ class RemoteClusterStore:
             self._release_slot(ep)
             raise
         self._checkin_conn(ep, sock)
-        if not resp.get("ok"):
-            raise_remote(resp)
         return resp
 
     def close(self) -> None:
@@ -625,6 +712,15 @@ class RemoteClusterStore:
     def ping(self) -> bool:
         return bool(self._request({"op": "ping"}).get("ok"))
 
+    def admission_info(self) -> dict:
+        """The server's per-lane admission table (``admission_info``
+        wire op): {lane: {inflight, streams, queued, admitted, sheds,
+        shed_reasons, deadline_expired, max_*}}, plus — against a
+        multi-process shard router — a ``workers`` map with each
+        worker's own table. Old servers raise (unknown op); vcctl
+        degrades to no table."""
+        return self._request({"op": "admission_info"})
+
     def add_interceptor(self, fn) -> None:
         raise NotImplementedError(
             "admission interceptors run in the process that OWNS the "
@@ -681,7 +777,14 @@ class RemoteClusterStore:
         # a watch() stuck mid-replay on a stalled server
         self._watch_socks.append(sock)
         kinds = list(subs)
-        send_frame(sock, {"op": op, "kinds": kinds, "replay": replay})
+        # bulk_watch is the controller fan-out path (control lane);
+        # plain watch setup defaults to this client's lane (read for
+        # dashboards/storms) — the gate can then shed a watch storm
+        # without touching the control plane's own streams
+        prio = "control" if op == "bulk_watch" \
+            else (current_lane() or self.lane or "read")
+        send_frame(sock, {"op": op, "kinds": kinds, "replay": replay,
+                          "prio": prio, "client": self.client_id})
         # per-kind, per-shard resume high-water marks; "sharded" flips
         # once any frame carries shard structure, switching the resume
         # request from the legacy scalar form to the per-shard map
@@ -839,8 +942,13 @@ class RemoteClusterStore:
             try:
                 sock = self._connect(endpoint)
                 self._watch_socks.append(sock)
+                # resume is CONTROL-lane regardless of the stream's
+                # original lane: keeping an already-established mirror
+                # consistent outranks admitting new read traffic
                 send_frame(sock, {"op": op, "kinds": list(subs),
-                                  "replay": False, "since": since})
+                                  "replay": False, "since": since,
+                                  "prio": "control",
+                                  "client": self.client_id})
                 # the missed-event replay lands here, inline
                 self._apply_stream(sock, subs, state, until_synced=True)
             except ResumeGapError as e:

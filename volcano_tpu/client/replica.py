@@ -249,10 +249,10 @@ class ReplicaServer(StoreServer):
                  port: int = 0, token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 tls_client_ca: Optional[str] = None):
+                 tls_client_ca: Optional[str] = None, gate=None):
         super().__init__(replica.store, host=host, port=port, token=token,
                          tls_cert=tls_cert, tls_key=tls_key,
-                         tls_client_ca=tls_client_ca)
+                         tls_client_ca=tls_client_ca, gate=gate)
         self.replica = replica
         self._server.replica = replica  # type: ignore[attr-defined]
 
@@ -287,10 +287,10 @@ class ShardedReplicaServer(ShardRouter):
                  port: int = 0, token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 tls_client_ca: Optional[str] = None):
+                 tls_client_ca: Optional[str] = None, gate=None):
         super().__init__(replica.store, host=host, port=port, token=token,
                          tls_cert=tls_cert, tls_key=tls_key,
-                         tls_client_ca=tls_client_ca)
+                         tls_client_ca=tls_client_ca, gate=gate)
         self.replica = replica
         self._server.replica = replica  # type: ignore[attr-defined]
 
@@ -411,11 +411,12 @@ class ReplicaStore:
     def serve(self, host: str = "127.0.0.1", port: int = 0,
               token: Optional[str] = None,
               tls_cert: Optional[str] = None, tls_key: Optional[str] = None,
-              tls_client_ca: Optional[str] = None) -> StoreServer:
+              tls_client_ca: Optional[str] = None,
+              gate=None) -> StoreServer:
         cls = ReplicaServer if self.n_shards == 1 else ShardedReplicaServer
         self.server = cls(self, host=host, port=port, token=token,
                           tls_cert=tls_cert, tls_key=tls_key,
-                          tls_client_ca=tls_client_ca).start()
+                          tls_client_ca=tls_client_ca, gate=gate).start()
         return self.server
 
     def start(self) -> "ReplicaStore":

@@ -39,6 +39,7 @@ from typing import Dict, Optional
 import hmac
 
 from ..resilience.faultinject import faults
+from ..resilience.overload import AdmissionGate, OverloadedError
 from .codec import decode, encode
 from .store import (
     KINDS, AdmissionError, ClusterStore, ConflictError, FencedError,
@@ -66,6 +67,7 @@ _ERRORS = {
     "ShardUnavailableError": ShardUnavailableError,
     "ReplicaReadOnlyError": ReplicaReadOnlyError,
     "ReplicaLagError": ReplicaLagError,
+    "OverloadedError": OverloadedError,
 }
 
 
@@ -232,7 +234,27 @@ def remote_error(resp: dict) -> Exception:
     """Rebuild a {"ok": false} response (or a bulk_apply per-item error
     entry) as its original exception class, without raising."""
     cls = _ERRORS.get(resp.get("error"), RuntimeError)
+    if cls is OverloadedError:
+        # the shed response's retry-after hint (and lane/reason) ride
+        # the frame as typed fields, not prose — rebuild them so the
+        # client's retry discipline can honor the hint
+        return OverloadedError(
+            resp.get("message", "request shed at the admission gate"),
+            retry_after_ms=resp.get("retry_after_ms"),
+            lane=resp.get("lane"), reason=resp.get("reason"))
     return cls(resp.get("message", "remote store error"))
+
+
+def overloaded_response(e: OverloadedError) -> dict:
+    """The wire form of a shed: typed error + retry-after hint."""
+    resp = {"ok": False, "error": "OverloadedError", "message": str(e)}
+    if e.retry_after_ms is not None:
+        resp["retry_after_ms"] = e.retry_after_ms
+    if e.lane is not None:
+        resp["lane"] = e.lane
+    if e.reason is not None:
+        resp["reason"] = e.reason
+    return resp
 
 
 def raise_remote(resp: dict) -> None:
@@ -352,19 +374,55 @@ class _Handler(socketserver.BaseRequestHandler):
                                       "message": "store auth failed"})
                     return
                 send_frame(sock, {"ok": True})
+            # every request-serving surface consults the admission gate
+            # before dispatch: per-lane bounded concurrency + bounded
+            # queues, typed sheds with a retry-after hint (see
+            # resilience/overload.py). gate=None only when explicitly
+            # disabled — the old-ungated-server behavior, byte for byte.
+            gate: Optional[AdmissionGate] = \
+                getattr(self.server, "gate", None)
             while True:
                 req = recv_frame(sock)
                 op = req.get("op")
-                if op in ("watch", "bulk_watch"):
-                    self._serve_watch(sock, store, req)
-                    return  # watch connections never go back to req/resp
-                if op == "ship":
-                    # WAL shipping (read replicas): the connection
-                    # becomes a one-way record stream, like watch
-                    self._serve_ship(sock, store, req)
-                    return
+                if op in ("watch", "bulk_watch", "ship"):
+                    # stream setup admits through the gate too: a storm
+                    # of new watchers queues/sheds at its lane instead
+                    # of spawning unbounded fan-out; the stream ticket
+                    # is held for the STREAM's lifetime so lanes with a
+                    # max_streams bound cap live fan-out, not just setup
+                    ticket = None
+                    if gate is not None:
+                        try:
+                            ticket = gate.admit(
+                                op, req, client=self._gate_client(req),
+                                stream=True)
+                        except OverloadedError as e:
+                            send_frame(sock, overloaded_response(e))
+                            continue
+                    try:
+                        if op == "ship":
+                            # WAL shipping (read replicas): the
+                            # connection becomes a one-way record
+                            # stream, like watch
+                            self._serve_ship(sock, store, req)
+                        else:
+                            self._serve_watch(sock, store, req)
+                    finally:
+                        if gate is not None:
+                            gate.release(ticket)
+                    return  # streams never go back to req/resp
+                ticket = None
                 try:
-                    resp = self._dispatch(store, op, req)
+                    if gate is not None:
+                        ticket = gate.admit(op, req,
+                                            client=self._gate_client(req))
+                    try:
+                        resp = self._dispatch(store, op, req)
+                    finally:
+                        if gate is not None:
+                            gate.release(ticket)
+                except OverloadedError as e:
+                    resp = overloaded_response(e)
                 except (ConflictError, NotFoundError, AdmissionError,
                         ShardUnavailableError, ReplicaReadOnlyError,
                         ReplicaLagError) as e:
@@ -393,8 +451,33 @@ class _Handler(socketserver.BaseRequestHandler):
         finally:
             self.server.active.discard(sock)  # type: ignore[attr-defined]
 
+    def _gate_client(self, req: dict) -> str:
+        """Flow identity for per-client fairness inside a lane: the
+        client's self-assigned id header when present (one per
+        RemoteClusterStore instance, stable across its pooled
+        connections), else the peer address — old clients still get a
+        flow of their own."""
+        client = req.get("client")
+        if client:
+            return str(client)
+        try:
+            return str(self.client_address[0])
+        except Exception:  # noqa: BLE001 — fairness only
+            return ""
+
+    def _admission_info(self) -> dict:
+        """Per-lane admission table (vcctl status): inflight/streams/
+        queued/sheds/deadline-expirations per lane, plus the configured
+        bounds. An ungated server reports enabled=False with no lanes."""
+        gate: Optional[AdmissionGate] = getattr(self.server, "gate", None)
+        if gate is None or not gate.enabled:
+            return {"ok": True, "enabled": False, "lanes": {}}
+        return {"ok": True, "enabled": True, "lanes": gate.stats()}
+
     def _dispatch(self, store: ClusterStore, op: str, req: dict) -> dict:
         kind = req.get("kind")
+        if op == "admission_info":
+            return self._admission_info()
         # fencing tokens ride the frame; the authoritative store validates
         # them against ITS lease record (the deposed writer's view of its
         # own leadership is exactly what cannot be trusted client-side)
@@ -741,7 +824,15 @@ class StoreServer:
     in clear. ``tls_client_ca`` additionally requires client
     certificates (mTLS). Non-loopback deployments should set these (or
     run inside a network layer that encrypts, e.g. a service mesh);
-    webhooks.server.generate_self_signed_cert bootstraps a dev pair."""
+    webhooks.server.generate_self_signed_cert bootstraps a dev pair.
+
+    ``gate``: the overload-admission gate every request consults before
+    dispatch (resilience/overload.py). Defaults to a gate with the
+    fail-safe generous lane limits — an unloaded deployment is
+    protocol-indistinguishable from an ungated one, an overloaded one
+    sheds ``read`` first and ``system`` never. Pass an
+    ``AdmissionGate(enabled=False)`` to run ungated (the pre-gate
+    behavior, for wire-compat tests against "old" servers)."""
 
     #: request handler; the shard router (client/sharded.py) subclasses
     #: with shard-aware watch serving over the same wire protocol
@@ -751,7 +842,8 @@ class StoreServer:
                  port: int = 0, token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 tls_client_ca: Optional[str] = None):
+                 tls_client_ca: Optional[str] = None,
+                 gate: Optional[AdmissionGate] = None):
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -775,6 +867,11 @@ class StoreServer:
         self._server.store = store  # type: ignore[attr-defined]
         self._server.token = token or ""  # type: ignore[attr-defined]
         self._server.ssl_ctx = ssl_ctx  # type: ignore[attr-defined]
+        # overload-admission gate, on by default (generous limits); an
+        # enabled=False gate serves ungated and the handler skips it
+        self.gate = gate if gate is not None else AdmissionGate()
+        self._server.gate = (  # type: ignore[attr-defined]
+            self.gate if self.gate.enabled else None)
         # resume window for reconnecting watchers (see EventJournal;
         # the shard router builds one journal per shard instead)
         self.journal = self._make_journal(store)

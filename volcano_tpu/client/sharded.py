@@ -750,10 +750,10 @@ class ShardRouter(StoreServer):
                  port: int = 0, token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 tls_client_ca: Optional[str] = None):
+                 tls_client_ca: Optional[str] = None, gate=None):
         super().__init__(store, host=host, port=port, token=token,
                          tls_cert=tls_cert, tls_key=tls_key,
-                         tls_client_ca=tls_client_ca)
+                         tls_client_ca=tls_client_ca, gate=gate)
         # encode-once event fan-out shared by every watch stream
         self.hub = _WatchHub(store)
         self._server.hub = self.hub  # type: ignore[attr-defined]

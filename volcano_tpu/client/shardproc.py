@@ -379,6 +379,15 @@ def main(argv=None) -> int:
     ap.add_argument("--scheduler-name", default="volcano")
     ap.add_argument("--default-queue", default="default")
     ap.add_argument("--faults", default=None)
+    ap.add_argument("--admission-lanes", default="",
+                    help="per-lane admission bounds for THIS worker's "
+                         "gate (lane=inflight[:queue[:streams]],...); "
+                         "each worker sheds independently, so one hot "
+                         "shard never touches its siblings")
+    ap.add_argument("--admission-queue-wait-ms", type=float,
+                    default=None,
+                    help="max milliseconds a request waits in a full "
+                         "lane before it is shed typed")
     ap.add_argument("--parent-pid", type=int, default=0,
                     help="exit when this process is no longer the "
                          "parent (supervisor died; don't leak workers "
@@ -415,8 +424,17 @@ def main(argv=None) -> int:
                                    token=args.token or None)
         start_webhooks(peer_view, scheduler_name=args.scheduler_name,
                        default_queue=args.default_queue)
+    # each worker owns its own admission gate: one hot shard sheds
+    # without touching its siblings (the router's gate fronts the
+    # cross-shard ops; single-key traffic meets only this one)
+    from ..resilience.overload import AdmissionGate, parse_lane_spec
+    gate_kw = {}
+    if args.admission_queue_wait_ms is not None:
+        gate_kw["queue_wait_ms"] = args.admission_queue_wait_ms
+    gate = AdmissionGate(parse_lane_spec(args.admission_lanes or None),
+                         **gate_kw)
     server = ShardWorkerServer(store, args.shard, port=args.port,
-                               token=args.token or None)
+                               token=args.token or None, gate=gate)
     server._server.peer_view = peer_view  # type: ignore[attr-defined]
     server.start()
     print(f"READY {server.port} shard={args.shard} rv={store._rv} "
@@ -475,6 +493,8 @@ class ShardProcSupervisor:
                  default_queue: str = "default",
                  admission: bool = True,
                  worker_faults=None,
+                 admission_lanes: Optional[str] = None,
+                 admission_queue_wait_ms: Optional[float] = None,
                  restart_backoff_base_s: float = 0.2,
                  restart_backoff_cap_s: float = 5.0,
                  ready_timeout_s: float = 60.0):
@@ -491,6 +511,9 @@ class ShardProcSupervisor:
         self.admission = admission
         #: fault spec applied to every worker, or {shard_idx: spec}
         self.worker_faults = worker_faults
+        #: per-lane admission bounds handed to every worker's own gate
+        self.admission_lanes = admission_lanes
+        self.admission_queue_wait_ms = admission_queue_wait_ms
         self.restart_backoff_base_s = restart_backoff_base_s
         self.restart_backoff_cap_s = restart_backoff_cap_s
         self.ready_timeout_s = ready_timeout_s
@@ -585,6 +608,11 @@ class ShardProcSupervisor:
             cmd += ["--token", self.token]
         if self.admission:
             cmd += ["--admission"]
+        if self.admission_lanes:
+            cmd += ["--admission-lanes", self.admission_lanes]
+        if self.admission_queue_wait_ms is not None:
+            cmd += ["--admission-queue-wait-ms",
+                    str(self.admission_queue_wait_ms)]
         if w.idx != 0:
             cmd += ["--arbiter", self.endpoint(0)]
         spec = self._faults_for(w.idx)
@@ -1119,6 +1147,20 @@ class _ProcRouterHandler(_Handler):
         # fault is ConnectionError-shaped and kills this connection so
         # the client's transport-retry rules engage
         faults.fire("shard_request")
+        if op == "admission_info":
+            # the router's own gate, plus each worker's (every worker
+            # owns an independent gate — one hot shard sheds alone)
+            resp = self._admission_info()
+            workers: Dict[str, Any] = {}
+            for i in range(store.n_shards):
+                try:
+                    wr = store.sup.request(i, {"op": "admission_info"})
+                    workers[str(i)] = wr.get("lanes") \
+                        if wr.get("ok") else None
+                except Exception:  # noqa: BLE001 — down worker: no table
+                    workers[str(i)] = None
+            resp["workers"] = workers
+            return resp
         return store.dispatch(op, req)
 
     def _serve_watch(self, sock: socket.socket, store: ProcShardedStore,
@@ -1285,10 +1327,10 @@ class ProcShardRouter(StoreServer):
                  port: int = 0, token: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 tls_client_ca: Optional[str] = None):
+                 tls_client_ca: Optional[str] = None, gate=None):
         super().__init__(store, host=host, port=port, token=token,
                          tls_cert=tls_cert, tls_key=tls_key,
-                         tls_client_ca=tls_client_ca)
+                         tls_client_ca=tls_client_ca, gate=gate)
 
     def _make_journal(self, store):
         return _NullJournal()

@@ -389,6 +389,43 @@ job_retry_total = registry.register(Counter(
     "Job controller re-enqueues after a failed sync (capped exponential "
     "backoff per job key)", ["job_id"]))
 
+# -- store admission metrics (resilience/overload.py AdmissionGate) ---------
+# every request-serving surface (StoreServer, ShardRouter, shard
+# workers, ProcShardRouter, ReplicaServer) exports these through its
+# process's registry; the retry-budget pair is CLIENT-side
+# (RemoteClusterStore's token bucket)
+
+store_admission_inflight = registry.register(Gauge(
+    "volcano_store_admission_inflight",
+    "Requests (and held streams) currently dispatched per admission "
+    "lane; system is unbounded, the bounded lanes queue then shed",
+    ["lane"]))
+store_admission_queued = registry.register(Gauge(
+    "volcano_store_admission_queued",
+    "Requests waiting in one admission lane's bounded FIFO (granted "
+    "round-robin across client flows; shed typed when the queue fills "
+    "or the queue-wait deadline passes)", ["lane"]))
+store_admission_sheds_total = registry.register(Counter(
+    "volcano_store_admission_sheds_total",
+    "Requests shed at the admission gate, by lane and reason "
+    "(queue_full, queue_wait, deadline, streams, fault). Every shed is "
+    "a typed OverloadedError with a retry-after hint — never a hang, "
+    "never a silent drop", ["lane", "reason"]))
+store_admission_deadline_expired_total = registry.register(Counter(
+    "volcano_store_admission_deadline_expired_total",
+    "Requests rejected because their wire deadline (deadline_ms "
+    "header) had already expired on arrival or lapsed while queued — "
+    "work nobody is waiting for anymore, not worth a thread", ["lane"]))
+store_admission_retry_budget = registry.register(Gauge(
+    "volcano_store_admission_retry_budget",
+    "Client-side retry-budget token balance (refilled at ~10% of "
+    "recent request volume; each Overloaded retry spends one)"))
+store_admission_retry_budget_exhausted_total = registry.register(Counter(
+    "volcano_store_admission_retry_budget_exhausted_total",
+    "Overloaded retries refused client-side because the retry budget "
+    "was dry (typed RetryBudgetExhausted to the caller; system-lane "
+    "ops bypass the budget)"))
+
 # -- durable store metrics (client/durable.py + client/server.py) -----------
 
 store_watch_dropped_total = registry.register(Counter(

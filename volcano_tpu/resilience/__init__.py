@@ -14,6 +14,13 @@ Three coordinated pieces plus the harness that proves them:
   the crash-safe bind write-ahead journal and the takeover
   reconciliation pass (wired by scheduler.run_with_leader_election,
   fenced by client.store.FencedStore);
+- ``overload.AdmissionGate`` — the store tier's overload-protected
+  front door: priority-lane admission (system/control/bulk/read) with
+  per-client fair queuing, wire deadlines, typed ``OverloadedError``
+  sheds with retry-after hints, and the client-side ``RetryBudget``
+  capping retries at ~10% of recent traffic (wired through every
+  request-serving surface in client/server.py and honored by
+  client/remote.py);
 - ``faultinject.faults`` — the deterministic, seeded fault-injection
   harness driving tests/test_resilience.py, tests/test_failover.py and
   ``bench.py chaos_churn``/``failover``.
@@ -21,13 +28,19 @@ Three coordinated pieces plus the harness that proves them:
 
 from .breaker import CircuitBreaker
 from .faultinject import FaultError, FaultInjector, faults
+from .overload import (
+    AdmissionGate, LaneStore, OverloadedError, RetryBudget,
+    RetryBudgetExhausted, parse_lane_spec,
+)
 from .recovery import BindIntentJournal, reconcile_bind_intents
 from .transient import TRANSIENT_MARKERS, is_transient, retry_transient
 from .watchdog import ActionTimeout, ActionWatchdog
 
 __all__ = [
-    "ActionTimeout", "ActionWatchdog", "BindIntentJournal",
-    "CircuitBreaker", "FaultError", "FaultInjector", "faults",
+    "ActionTimeout", "ActionWatchdog", "AdmissionGate",
+    "BindIntentJournal", "CircuitBreaker", "FaultError", "FaultInjector",
+    "LaneStore", "OverloadedError", "RetryBudget",
+    "RetryBudgetExhausted", "faults", "parse_lane_spec",
     "reconcile_bind_intents", "TRANSIENT_MARKERS", "is_transient",
     "retry_transient",
 ]

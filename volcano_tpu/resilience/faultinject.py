@@ -96,7 +96,18 @@ refuses the NEXT record and re-bootstraps from a fresh snapshot,
 counted in volcano_replica_bootstraps_total{reason="apply_gap"} —
 never a silently served gap), and ``replica_apply_dup`` (same seam,
 after the apply — an armed firing applies the record a second time;
-the rv repeat is refused immediately, same re-bootstrap).
+the rv repeat is refused immediately, same re-bootstrap),
+``admission_shed`` (resilience/overload.py AdmissionGate.admit, after
+the deadline check and before any lane accounting — an armed firing
+forces the Nth admitted request to SHED regardless of lane: the server
+answers the typed OverloadedError + retry-after frame and the client's
+retry-budget discipline engages; the deterministic storm-in-a-box the
+overload tests arm against a live server), and ``request_deadline``
+(same seam, first check — an armed firing treats the Nth request as
+EXPIRED ON ARRIVAL exactly as if its ``deadline_ms`` wire header had
+already lapsed: counted in
+volcano_store_admission_deadline_expired_total and refused typed
+without burning a dispatch thread).
 """
 
 from __future__ import annotations

@@ -65,13 +65,27 @@ class Standalone:
                  store_snapshot_every: int = 4096,
                  store_shards: int = 1,
                  store_shard_procs: bool = False,
-                 controller_shard_workers: int = 1):
+                 controller_shard_workers: int = 1,
+                 admission_lanes: Optional[str] = None,
+                 admission_queue_wait_ms: Optional[float] = None):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
         from .metrics.server import MetricsServer
         from .scheduler import Scheduler
         from .webhooks import start_webhooks
+
+        # overload-protected front door (resilience/overload.py): every
+        # served endpoint gets an admission gate — fail-safe defaults
+        # (gate on, generous limits) unless --admission-lanes tightens
+        # them; shard WORKERS each get their own gate via the supervisor
+        from .resilience.overload import AdmissionGate, parse_lane_spec
+
+        def make_gate():
+            kw = {}
+            if admission_queue_wait_ms is not None:
+                kw["queue_wait_ms"] = admission_queue_wait_ms
+            return AdmissionGate(parse_lane_spec(admission_lanes), **kw)
 
         self._shard_supervisor = None
         if store_shard_procs:
@@ -107,10 +121,13 @@ class Standalone:
                 snapshot_every=store_snapshot_every,
                 token=token or None,
                 scheduler_name=scheduler_name,
-                default_queue=default_queue).start()
+                default_queue=default_queue,
+                admission_lanes=admission_lanes,
+                admission_queue_wait_ms=admission_queue_wait_ms).start()
             self.store_server = ProcShardRouter(
                 ProcShardedStore(self._shard_supervisor),
-                host, port, token=token or None).start()
+                host, port, token=token or None,
+                gate=make_gate()).start()
             self.store = RemoteClusterStore(
                 self.store_server.address, token=token or None,
                 direct_watch=True)
@@ -196,7 +213,7 @@ class Standalone:
             self.store_server = server_cls(
                 self.store, host, int(port), token=token,
                 tls_cert=tls_cert, tls_key=tls_key,
-                tls_client_ca=tls_ca).start()
+                tls_client_ca=tls_ca, gate=make_gate()).start()
         self.webhook_server = None
         if serve_webhooks_tls:
             from .webhooks import serve_webhooks
@@ -268,8 +285,16 @@ class Standalone:
             from .parallel.sidecar import SidecarSolver
             self.cache.sidecar = SidecarSolver(sidecar_path)
         self.cache.run()
+        # controller traffic rides the CONTROL admission lane: when the
+        # store is a remote client (shard-procs mode) the LaneStore view
+        # tags every controller op so the gate can shed read storms
+        # without starving the control plane's own feedback loops
+        ctrl_store = self.store
+        if self._shard_supervisor is not None:
+            from .resilience.overload import LaneStore
+            ctrl_store = LaneStore(self.store, "control")
         self.controllers = ControllerManager(
-            self.store, scheduler_name=scheduler_name,
+            ctrl_store, scheduler_name=scheduler_name,
             default_queue=default_queue,
             shard_workers=controller_shard_workers)
         self.controllers.run()
@@ -378,7 +403,9 @@ class Standalone:
         self.store.create("jobs", _job_from_yaml(yaml.safe_load(text)))
 
 
-def run_replica(primary: str, serve: str, metrics_port: int = 0) -> int:
+def run_replica(primary: str, serve: str, metrics_port: int = 0,
+                admission_lanes: Optional[str] = None,
+                admission_queue_wait_ms: Optional[float] = None) -> int:
     """Replica-only process mode (``--store-replica-of``): no scheduler,
     no controllers, no webhooks — bootstrap from the primary's newest
     snapshot, tail its shipped WAL, and serve the read tier
@@ -403,8 +430,17 @@ def run_replica(primary: str, serve: str, metrics_port: int = 0) -> int:
     replica = ReplicaStore(primary, token=token or None,
                            tls_ca=os.environ.get("VOLCANO_STORE_CA")
                            or None)
+    # the replica IS the read tier: its gate sheds list/watch storms
+    # typed instead of letting them starve the tailer keeping it fresh
+    from .resilience.overload import AdmissionGate, parse_lane_spec
+    gate_kw = {}
+    if admission_queue_wait_ms is not None:
+        gate_kw["queue_wait_ms"] = admission_queue_wait_ms
     server = replica.serve(host, int(port), token=token or None,
-                           tls_cert=tls_cert, tls_key=tls_key)
+                           tls_cert=tls_cert, tls_key=tls_key,
+                           gate=AdmissionGate(
+                               parse_lane_spec(admission_lanes),
+                               **gate_kw))
     replica.start()
     metrics_server = MetricsServer(port=metrics_port).start()
     print(f"volcano-tpu replica up; following {primary}; serving reads "
@@ -504,6 +540,28 @@ def main(argv=None) -> int:
                     help="bind address for the replica read endpoint "
                          "(requires --store-replica-of; same wire "
                          "protocol and auth/TLS rules as --serve-store)")
+    ap.add_argument("--admission-lanes", default=None, metavar="SPEC",
+                    help="per-lane overload-admission bounds for every "
+                         "served store endpoint (and, with "
+                         "--store-shard-procs, each worker's own gate): "
+                         "lane=inflight[:queue[:streams]] comma-"
+                         "separated, 0 = unbounded. Lanes: system "
+                         "(fenced writes/leases — never shed), control "
+                         "(controller syncs, bulk_watch/resume), bulk "
+                         "(bulk_apply waves), read (lists/gets/plain "
+                         "watch — sheds first). Default: gate ON with "
+                         "generous fail-safe limits "
+                         "(control=64:256, bulk=32:128, read=64:1024); "
+                         "an unloaded deployment is protocol-"
+                         "indistinguishable from an ungated one. "
+                         "Example: read=16:64:32,bulk=8:32")
+    ap.add_argument("--admission-queue-wait-ms", type=float,
+                    default=None, metavar="MS",
+                    help="max milliseconds a request waits in a full "
+                         "admission lane before it is shed with a "
+                         "typed OverloadedError + retry-after hint "
+                         "(default 2000; requests carrying a tighter "
+                         "wire deadline_ms shed at that instead)")
     ap.add_argument("--controller-shard-workers", type=int, default=1,
                     metavar="N",
                     help="fan the job controller's sync drain out "
@@ -604,8 +662,11 @@ def main(argv=None) -> int:
         if not args.serve_replica:
             ap.error("--store-replica-of requires --serve-replica "
                      "(a replica exists to serve reads)")
-        return run_replica(args.store_replica_of, args.serve_replica,
-                           metrics_port=args.metrics_port)
+        return run_replica(
+            args.store_replica_of, args.serve_replica,
+            metrics_port=args.metrics_port,
+            admission_lanes=args.admission_lanes,
+            admission_queue_wait_ms=args.admission_queue_wait_ms)
     if args.serve_replica:
         ap.error("--serve-replica requires --store-replica-of")
 
@@ -645,7 +706,9 @@ def main(argv=None) -> int:
                     store_snapshot_every=args.store_snapshot_every,
                     store_shards=args.store_shards,
                     store_shard_procs=args.store_shard_procs,
-                    controller_shard_workers=args.controller_shard_workers)
+                    controller_shard_workers=args.controller_shard_workers,
+                    admission_lanes=args.admission_lanes,
+                    admission_queue_wait_ms=args.admission_queue_wait_ms)
     if args.jobs_dir:
         import glob
         import os
