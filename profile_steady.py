@@ -126,6 +126,70 @@ def main():
           if order_full_ms else None)
     print("timing", {k: (round(v, 2) if isinstance(v, float) else v)
                      for k, v in sched.last_cycle_timing.items()})
+    wire_delta_probe()
+
+
+def wire_delta_probe(n_pods: int = 2000, flips: int = 4):
+    """Wire-path companion to the in-process columns above: a live
+    StoreServer, one delta-negotiated mirror and one object-path mirror,
+    the same phase-flip churn through both — printed as the
+    decode-vs-apply ms split (client/remote.py delta_stats) next to the
+    flatten/ordering numbers."""
+    import copy
+
+    from volcano_tpu.client.remote import RemoteClusterStore
+    from volcano_tpu.client.server import StoreServer
+
+    store = ClusterStore()
+    srv = StoreServer(store).start()
+    arms = {}
+    for name, delta in (("delta", True), ("object", False)):
+        c = RemoteClusterStore(srv.address, delta_watch=delta)
+        mirror = {}
+
+        def on_pod(event, obj, old, changed=None, _m=mirror):
+            if event == "delete":
+                _m.pop(f"{obj.namespace}/{obj.name}", None)
+            else:
+                _m[f"{obj.namespace}/{obj.name}"] = obj
+        on_pod.delta_aware = True
+        c.watch("pods", on_pod)
+        arms[name] = (c, mirror)
+    pods = [build_pod("bench", f"wp{i}", "", "Pending",
+                      {"cpu": "1"}, f"wj{i % 50}") for i in range(n_pods)]
+    for p in pods:
+        store.create("pods", p)
+    t0 = time.perf_counter()
+    phases = ["Running", "Succeeded", "Pending", "Running"]
+    for f in range(flips):
+        for p in pods:
+            cur = copy.deepcopy(
+                store.get("pods", p.name, namespace="bench"))
+            cur.phase = phases[f % len(phases)]
+            cur.node_name = f"n{f}"
+            store.update("pods", cur)
+    applied = store._rv
+    for c, _ in arms.values():
+        c.wait_stream_applied("pods", applied, timeout=60.0)
+    wall = (time.perf_counter() - t0) * 1e3
+    dc, dm = arms["delta"]
+    oc, om = arms["object"]
+    n_ev = n_pods * flips
+    assert all(dm[k].phase == om[k].phase and dm[k].node_name
+               == om[k].node_name for k in om), "mirror divergence"
+    st = dc.delta_stats
+    print(f"wire delta: {st['events']}/{n_ev} events as patches, "
+          f"decode {st['decode_ms']:.2f} ms vs apply "
+          f"{st['apply_ms']:.2f} ms "
+          f"({1e3 * (st['decode_ms'] + st['apply_ms']) / max(1, st['events']):.2f} us/event), "
+          f"vocab {st['vocab']}, fallbacks {st['fallbacks']}")
+    print(f"wire bytes: delta arm {st['bytes_delta']}, object arm "
+          f"{oc.delta_stats['bytes_object']} "
+          f"({oc.delta_stats['bytes_object'] / max(1, st['bytes_delta']):.1f}x), "
+          f"churn wall {wall:.0f} ms for {n_ev} updates x 2 mirrors")
+    for c, _ in arms.values():
+        c.close()
+    srv.stop()
 
 
 if __name__ == "__main__":

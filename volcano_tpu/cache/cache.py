@@ -18,7 +18,7 @@ from ..api import (
     ClusterInfo, JobInfo, NamespaceCollection, NodeInfo, QueueInfo, Resource,
     TaskInfo, TaskStatus,
 )
-from ..api.job_info import job_key_of_pod
+from ..api.job_info import job_key_of_pod, pod_key, status_of_pod
 from ..models import (
     PodGroup, PodGroupCondition, PodGroupPhase, Queue, QueueSpec,
 )
@@ -26,6 +26,17 @@ from ..client.store import ClusterStore, ConflictError, NotFoundError
 from ..metrics import metrics
 
 log = logging.getLogger(__name__)
+
+#: pod fields a delta watch patch may change while staying on the
+#: targeted-update path (apply_pod_delta): none of these move the task
+#: to a different job (annotations), change its identity (name/
+#: namespace/uid), its resource shape (containers/init_containers), or
+#: its owner (scheduler_name) — changes outside this set rebuild the
+#: TaskInfo through the generic update ladder
+_DELTA_FAST_FIELDS = frozenset((
+    "phase", "deletion_timestamp", "node_name", "priority",
+    "resource_version", "container_statuses", "conditions", "labels",
+))
 
 
 class DefaultBinder:
@@ -428,7 +439,7 @@ class SchedulerCache:
         if oc is not None:
             oc.feed_event(kind, event, job=job, node=node)
 
-    def _on_pod(self, event, obj, old):
+    def _on_pod(self, event, obj, old, changed=None):
         if obj.scheduler_name == self.scheduler_name:
             key = job_key_of_pod(obj)
             self._feed_flatten("pod", event, job=key,
@@ -448,9 +459,17 @@ class SchedulerCache:
             else:
                 self.add_pod(obj)
         elif event == "update":
-            self.update_pod(old, obj)
+            # a delta watch stream names the changed fields; when they
+            # fit the targeted path, skip the full TaskInfo rebuild
+            if changed is None or not self.apply_pod_delta(
+                    old, obj, changed):
+                self.update_pod(old, obj)
         else:
             self.delete_pod(obj)
+
+    # a delta-capable store passes (event, obj, old, changed_fields) —
+    # detected via getattr on the bound method (client/remote.py)
+    _on_pod.delta_aware = True
 
     def _on_node(self, event, obj, old):
         # an "add" for an already-known node is a respec in place (no
@@ -568,6 +587,43 @@ class SchedulerCache:
         except KeyError:
             pass
         self.add_task(TaskInfo(new_pod))
+
+    def apply_pod_delta(self, old_pod, new_pod, changed) -> bool:
+        """Targeted update for a delta-watch column patch: ``changed``
+        names the pod fields the patch touched. When they all fit the
+        safe set, re-place the STORED TaskInfo through the same
+        delete_task/add_task seams the generic path uses — identical
+        index ordering, aggregate arithmetic and node accounting — but
+        without re-deriving a TaskInfo (the resreq parse and status/key
+        derivation are the per-event cost this path exists to kill).
+        Returns False when the caller must run the generic rebuild."""
+        if not _DELTA_FAST_FIELDS.issuperset(changed):
+            return False
+        if new_pod.scheduler_name != self.scheduler_name:
+            return True  # not ours: same early-out as update_pod
+        job = self.jobs.get(job_key_of_pod(new_pod))
+        stored = job.tasks.get(pod_key(new_pod)) \
+            if job is not None else None
+        if stored is None:
+            # bare pod or a task this mirror never added: the generic
+            # ladder owns the odd cases
+            return False
+        try:
+            self.delete_task(stored)
+        except KeyError:
+            pass
+        stored.node_name = new_pod.node_name or ""
+        stored.status = status_of_pod(new_pod)
+        stored.priority = new_pod.priority \
+            if new_pod.priority is not None else 1
+        # reset exactly what a fresh TaskInfo(new_pod) would: the
+        # rebuilt arm of an A/B run must not observe state this arm
+        # carried over
+        stored.volume_ready = False
+        stored.sig_cache = None
+        stored.pod = new_pod
+        self.add_task(stored)
+        return True
 
     def delete_pod(self, pod) -> None:
         if pod.scheduler_name != self.scheduler_name:

@@ -141,6 +141,13 @@ def encode(obj: Any) -> Any:
     return {_T: t.__name__, "f": fields}
 
 
+def known_fields(cls: type) -> frozenset:
+    """Constructable field names of a model dataclass (the set decode()
+    filters against) — the delta patch path validates field names from
+    the wire against this before any setattr."""
+    return _known(cls)
+
+
 def decode(data: Any) -> Any:
     """JSON structure -> model object (closed over the models registry).
     Fields absent from the wire regain their class defaults."""
@@ -168,3 +175,136 @@ def decode(data: Any) -> Any:
     if isinstance(data, list):
         return [decode(v) for v in data]
     return data
+
+
+# -- delta watch dialect ------------------------------------------------------
+#
+# The ``delta: true`` watch mode (client/server.py negotiation) ships an
+# UPDATE event as a field-sparse column patch instead of the full object
+# form: one interned key id ("dk"), parallel columns of interned field
+# ids and wire values ("df"/"dv"), and the fields that returned to their
+# class defaults ("dx"). Hot immutable strings and enums (names, nodes,
+# phases) are interned into an append-only per-stream table — the frame
+# carries {"__i": id} references plus the table additions this event
+# created ("tb": [start, [entries...]]) — so a storm of phase flips costs
+# a few ints per event on the wire and ZERO full-object decodes on the
+# client. Adds/deletes (and any update the dialect cannot express) stay
+# object frames; the two forms interleave freely on one stream, which is
+# what keeps journal-resume replay (always object form) compatible.
+
+_I = "__i"   # interned-value reference (delta frames only)
+
+#: interning-table hard cap per stream/shard: past this the server ships
+#: raw values (no fallback needed server-side); a CLIENT asked to grow
+#: beyond its own cap falls back typed (``vocab_overflow``)
+DELTA_VOCAB_MAX = 65536
+
+
+class Interner:
+    """Append-only value table for the delta dialect. Entries are wire
+    (encoded) values — plain strings, or tagged enum forms — identified
+    by position; callers snapshot the whole table into the stream's
+    ``synced`` frame and ship per-event additions in order, so both
+    sides' tables stay id-aligned without any retraction protocol."""
+
+    __slots__ = ("entries", "_ids", "cap")
+
+    def __init__(self, cap: int = DELTA_VOCAB_MAX):
+        self.entries: List[Any] = []
+        self._ids: Dict[Any, int] = {}
+        self.cap = cap
+
+    def intern(self, enc: Any) -> Optional[int]:
+        """Table id for an encoded value worth interning (str, or the
+        tagged enum form), or None when it must ship raw — not an
+        internable shape, or the table is at cap."""
+        if isinstance(enc, str):
+            key: Any = enc
+        elif isinstance(enc, dict) and len(enc) == 2 and _E in enc:
+            key = (_E, enc[_E], enc["v"])
+        else:
+            return None
+        i = self._ids.get(key)
+        if i is None:
+            if len(self.entries) >= self.cap:
+                return None
+            i = len(self.entries)
+            self._ids[key] = i
+            self.entries.append(enc)
+        return i
+
+    def snapshot(self) -> List[Any]:
+        return list(self.entries)
+
+
+def object_key(obj: Any) -> str:
+    """The store bucket key of a model object ('<ns>/<name>', or bare
+    name for unnamespaced kinds) — what a patch's ``dk`` id resolves to
+    on both sides of the wire."""
+    ns = getattr(obj, "namespace", None)
+    return f"{ns}/{obj.name}" if ns is not None else obj.name
+
+
+def delta_diff(enc_new: Any, enc_old: Any) -> Optional[Tuple[dict, list]]:
+    """Field-sparse diff of two sparse-encoded ({__t, f}) forms of the
+    same object: ``(changed {field: wire value}, cleared [field, ...])``
+    where *cleared* fields went back to their class defaults (encode()
+    omitted them). None when the dialect cannot express the change —
+    either side is not a tagged dataclass form, or the class changed."""
+    if not (isinstance(enc_new, dict) and isinstance(enc_old, dict)):
+        return None
+    tag = enc_new.get(_T)
+    if tag is None or tag != enc_old.get(_T):
+        return None
+    fnew, fold = enc_new["f"], enc_old["f"]
+    changed = {k: v for k, v in fnew.items()
+               if k not in fold or fold[k] != v}
+    cleared = [k for k in fold if k not in fnew]
+    return changed, cleared
+
+
+def delta_value(enc: Any, interner: Interner) -> Any:
+    """Wire form of one changed field's encoded value: an {"__i": id}
+    reference for interned hot immutables, the raw encoded value
+    otherwise — escaped when a genuine single-key user dict could be
+    mistaken for a reference."""
+    i = interner.intern(enc)
+    if i is not None:
+        return {_I: i}
+    if isinstance(enc, dict) and len(enc) == 1 and _I in enc:
+        return {_D: enc}
+    return enc
+
+
+def delta_resolve(v: Any, table: List[Any]) -> Any:
+    """One wire value back to a model value: interned references hit the
+    table's pre-decoded cache (so a phase flip pays zero decode); raw
+    values go through decode(). IndexError on an unknown reference — the
+    caller's typed ``schema_skew`` fallback."""
+    if isinstance(v, dict) and len(v) == 1 and _I in v:
+        return table[v[_I]]
+    return decode(v)
+
+
+#: per dataclass: field name -> dataclasses.Field (clearing support)
+_FIELD_MAP: Dict[type, Dict[str, Any]] = {}
+
+
+def field_default(cls: type, name: str) -> Any:
+    """A fresh default for clearing field ``name`` back to its class
+    default (fresh container per call: cleared fields must never share
+    mutable state across objects). ValueError when the field has no
+    default — a patch clearing a required field is schema skew."""
+    fmap = _FIELD_MAP.get(cls)
+    if fmap is None:
+        fmap = _FIELD_MAP[cls] = {
+            f.name: f for f in dataclasses.fields(cls)}
+    f = fmap.get(name)
+    if f is None:
+        raise ValueError(f"{cls.__name__} has no field {name!r}")
+    if f.default is not dataclasses.MISSING:
+        return f.default
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        return f.default_factory()  # type: ignore[misc]
+    raise ValueError(
+        f"field {cls.__name__}.{name} has no default to clear to")

@@ -20,6 +20,7 @@ CAS of utils.leader_election work across processes.
 
 from __future__ import annotations
 
+import copy
 import json
 import logging
 import os
@@ -34,8 +35,14 @@ from ..resilience.overload import (
     OverloadedError, RetryBudget, RetryBudgetExhausted, classify,
     current_lane,
 )
-from .codec import decode, encode
-from .server import MAGIC, raise_remote, recv_frame, remote_error, send_frame
+from .codec import (
+    DELTA_VOCAB_MAX, decode, delta_resolve, encode, field_default,
+    known_fields, object_key,
+)
+from .server import (
+    MAGIC, raise_remote, recv_frame, recv_frame_sized, remote_error,
+    send_frame,
+)
 from .sharded import shard_for
 from .store import ResumeGapError, ShardUnavailableError, _key
 
@@ -47,6 +54,16 @@ log = logging.getLogger(__name__)
 #: trips the server's cap or stalls every other request behind it
 BULK_CHUNK_BYTES = 8 << 20
 BULK_CHUNK_ITEMS = 2048
+
+
+class DeltaFallbackError(ValueError):
+    """Typed refusal of a delta watch frame (the reason is ``args[0]``:
+    ``delta_gap`` / ``vocab_overflow`` / ``unknown_field`` /
+    ``schema_skew``). A ValueError so the stream reader's existing
+    broken-stream handling catches it: the stream resumes through the
+    normal journal-replay path — with the delta ask OFF — from a
+    high-water mark the refused frame never advanced, so the fallback
+    loses and repeats nothing."""
 
 
 class RemoteClusterStore:
@@ -107,7 +124,8 @@ class RemoteClusterStore:
                  direct_watch: bool = False,
                  lane: Optional[str] = None,
                  op_deadline_ms: float = 0.0,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 delta_watch: bool = False):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -202,6 +220,23 @@ class RemoteClusterStore:
         self.client_id = uuid.uuid4().hex[:12]  # flow-fairness identity
         self.overload_retries = 0      # Overloaded responses retried
         self.overload_sheds_seen = 0   # OverloadedError surfaced typed
+        # -- delta watch (client/codec.py delta dialect) ----------------
+        # opt-in: ask every watch stream for column-patch frames and
+        # apply them straight onto the mirrored objects; any frame the
+        # dialect can't express — or any consistency break — falls back
+        # typed to the object path (fail-safe default: off)
+        self.delta_watch = bool(delta_watch)
+        self.delta_vocab_max = DELTA_VOCAB_MAX
+        #: cumulative across this client's streams, read by
+        #: _export_pipeline_metrics and profile_steady: wire frames on
+        #: delta streams, patch events applied, fields written, wire
+        #: bytes by mode, decode-vs-apply ms split, peak table size,
+        #: and typed fallback counts by reason
+        self.delta_stats: Dict[str, Any] = {
+            "frames": 0, "events": 0, "fields": 0,
+            "bytes_delta": 0, "bytes_object": 0,
+            "decode_ms": 0.0, "apply_ms": 0.0,
+            "vocab": 0, "fallbacks": {}}
 
     # -- plumbing -----------------------------------------------------------
 
@@ -783,16 +818,44 @@ class RemoteClusterStore:
         # without touching the control plane's own streams
         prio = "control" if op == "bulk_watch" \
             else (current_lane() or self.lane or "read")
-        send_frame(sock, {"op": op, "kinds": kinds, "replay": replay,
-                          "prio": prio, "client": self.client_id})
+        req = {"op": op, "kinds": kinds, "replay": replay,
+               "prio": prio, "client": self.client_id}
+        if self.delta_watch:
+            req["delta"] = True
+        send_frame(sock, req)
         # per-kind, per-shard resume high-water marks; "sharded" flips
         # once any frame carries shard structure, switching the resume
-        # request from the legacy scalar form to the per-shard map
-        state = {"hwm": {}, "sharded": False}
+        # request from the legacy scalar form to the per-shard map.
+        # The delta keys: "delta_ask" (request the mode on (re)connect —
+        # cleared forever by a typed fallback, kept across transport
+        # breaks), "delta_on" (this stream's synced frame granted it),
+        # "vtab"/"ks" (per-shard interning tables and frame-sequence
+        # baselines), "objs" (per-kind key -> live mirrored object, the
+        # ledger a patch's dk resolves against)
+        state: Dict[str, Any] = {
+            "hwm": {}, "sharded": False,
+            "delta_ask": self.delta_watch, "delta_on": False,
+            "vtab": {}, "ks": {},
+            "objs": {} if self.delta_watch else None}
         desc = (kinds[0] if len(kinds) == 1
                 else f"bulk({','.join(kinds)})") + suffix
         try:
-            self._apply_stream(sock, subs, state, until_synced=True)
+            try:
+                self._apply_stream(sock, subs, state, until_synced=True)
+            except DeltaFallbackError:
+                # typed delta refusal during the open phase (a synced
+                # frame's table the client can't hold or parse): retry
+                # once with the ask off — fail-safe object frames. The
+                # re-replayed adds land as add-as-update resyncs.
+                self._drop_watch_sock(sock)
+                sock = self._connect(endpoint)
+                self._watch_socks.append(sock)
+                req.pop("delta", None)
+                send_frame(sock, req)
+                state = {"hwm": {}, "sharded": False,
+                         "delta_ask": False, "delta_on": False,
+                         "vtab": {}, "ks": {}, "objs": None}
+                self._apply_stream(sock, subs, state, until_synced=True)
         except Exception:
             # server refused the subscription (e.g. unknown kind) or died
             # mid-replay: surface it to the caller, nothing to resume yet
@@ -862,7 +925,7 @@ class RemoteClusterStore:
         lock hold per batch). Returns at the 'synced' marker when
         ``until_synced``, else loops until the connection dies."""
         while True:
-            msg = recv_frame(sock)
+            msg, nbytes = recv_frame_sized(sock)
             faults.fire("watch_stream")
             if msg.get("ok") is False:
                 raise_remote(msg)
@@ -875,6 +938,8 @@ class RemoteClusterStore:
                             self._advance_hwm(state, kind, rvmap[kind])
                             for sh, rv in state["hwm"][kind].items():
                                 self._fold_hwm(kind, sh, rv)
+                    if state.get("delta_ask"):
+                        self._delta_synced(state, msg)
                     self._hwm_cv.notify_all()
                 if until_synced:
                     return
@@ -891,25 +956,209 @@ class RemoteClusterStore:
             # mirror concurrently with a later kind's replay — cache
             # handlers rely on the store serializing dispatch
             with self._lock:
+                delta_on = state.get("delta_on", False)
+                st = self.delta_stats
+                # wire accounting for BOTH modes, so a delta client and
+                # an object client measure the same thing and the bytes
+                # columns compare like-for-like
+                st["bytes_delta" if delta_on else "bytes_object"] += nbytes
+                if delta_on:
+                    st["frames"] += 1
                 for ev in batch:
                     kind = ev.get("kind")
-                    fns = subs.get(kind)
-                    if fns:
-                        old = ev.get("old")
-                        obj = decode(ev["obj"])
-                        oldo = decode(old) if old is not None else None
-                        for fn in fns:
-                            fn(ev["event"], obj, oldo)
+                    shard = ev.get("shard")
+                    sh = str(shard) if shard is not None else "0"
+                    if delta_on:
+                        ksv = ev.get("ks")
+                        if ksv is not None:
+                            # dense per-(kind, shard) frame sequence: a
+                            # gap means a frame was lost between server
+                            # and here, a repeat means one applied
+                            # already — refuse BEFORE touching anything
+                            kmap = state["ks"].setdefault(kind, {})
+                            if int(ksv) != kmap.get(sh, 0) + 1:
+                                self._delta_fallback(state, "delta_gap")
+                            kmap[sh] = int(ksv)
+                            tb = ev.get("tb")
+                            if tb is not None:
+                                self._delta_extend_vtab(state, kind,
+                                                        sh, tb)
+                    if "dk" in ev:
+                        if not delta_on:
+                            # a patch outside negotiated delta mode can
+                            # only be a protocol break
+                            self._delta_fallback(state, "schema_skew")
+                        self._apply_patch(ev, subs, state, sh)
+                    else:
+                        fns = subs.get(kind)
+                        obj = None
+                        if fns:
+                            old = ev.get("old")
+                            obj = decode(ev["obj"])
+                            oldo = decode(old) if old is not None else None
+                            for fn in fns:
+                                fn(ev["event"], obj, oldo)
+                        objs = state.get("objs")
+                        if objs is not None and kind is not None:
+                            # the delta ledger mirrors live objects by
+                            # store key so later patches can resolve dk
+                            if obj is None:
+                                obj = decode(ev["obj"])
+                            km = objs.setdefault(kind, {})
+                            if ev.get("event") == "delete":
+                                km.pop(object_key(obj), None)
+                            else:
+                                km[object_key(obj)] = obj
                     rv = ev.get("rv")
                     if rv is not None:
-                        shard = ev.get("shard")
                         if shard is not None:
                             state["sharded"] = True
                         hk = state["hwm"].setdefault(kind, {})
-                        sh = str(shard) if shard is not None else "0"
                         hk[sh] = max(hk.get(sh, -1), int(rv))
                         self._fold_hwm(kind, sh, hk[sh])
                 self._hwm_cv.notify_all()
+
+    # -- delta watch application (client/codec.py delta dialect) ------------
+
+    def _delta_synced(self, state: dict, msg: dict) -> None:
+        """Fold a synced frame's delta grant into the stream state.
+        Caller holds self._lock and has checked ``delta_ask``."""
+        if not msg.get("delta"):
+            # server (or one relay upstream) declined: fail-safe object
+            # frames, and stop asking — the answer won't change
+            state["delta_on"] = False
+            state["delta_ask"] = False
+            state["objs"] = None
+            return
+        try:
+            vtab = {k: {str(sh): [decode(e) for e in entries]
+                        for sh, entries in m.items()}
+                    for k, m in (msg.get("vtab") or {}).items()}
+        except Exception:  # noqa: BLE001 — unparseable table entry
+            self._delta_fallback(state, "schema_skew")
+        for m in vtab.values():
+            for entries in m.values():
+                if len(entries) > self.delta_vocab_max:
+                    self._delta_fallback(state, "vocab_overflow")
+        # REPLACE, never merge: each synced is a full snapshot atomic
+        # with the (re)subscription it rode in on
+        state["vtab"] = vtab
+        state["ks"] = {k: {str(sh): int(n) for sh, n in m.items()}
+                       for k, m in (msg.get("ks") or {}).items()}
+        state["delta_on"] = True
+        if state.get("objs") is None:
+            state["objs"] = {}
+        vocab = max((len(t) for m in vtab.values()
+                     for t in m.values()), default=0)
+        if vocab > self.delta_stats["vocab"]:
+            self.delta_stats["vocab"] = vocab
+
+    def _delta_extend_vtab(self, state: dict, kind: str, sh: str,
+                           tb) -> None:
+        """Apply a frame's interning-table additions ([start, entries])
+        to that kind's table — tables are per (kind, shard) so a stream
+        watching a subset of kinds stays id-aligned with the server.
+        Caller holds self._lock; ks continuity already passed."""
+        table = state["vtab"].setdefault(kind, {}).setdefault(sh, [])
+        try:
+            t0, entries = tb
+        except (TypeError, ValueError):
+            self._delta_fallback(state, "schema_skew")
+        if t0 != len(table):
+            # additions for a table we don't have: the streams' tables
+            # are no longer id-aligned
+            self._delta_fallback(state, "schema_skew")
+        if t0 + len(entries) > self.delta_vocab_max:
+            self._delta_fallback(state, "vocab_overflow")
+        try:
+            table.extend(decode(e) for e in entries)
+        except Exception:  # noqa: BLE001 — unparseable entry
+            self._delta_fallback(state, "schema_skew")
+        if len(table) > self.delta_stats["vocab"]:
+            self.delta_stats["vocab"] = len(table)
+
+    def _delta_fallback(self, state: dict, reason: str) -> None:
+        """Typed refusal: record it, clear the stream's delta state so
+        the resume reconnects plain, and raise. The failed frame applied
+        NOTHING and advanced no high-water mark, so the object-path
+        resume replay neither loses nor repeats an event. Caller holds
+        self._lock."""
+        state["delta_on"] = False
+        state["delta_ask"] = False
+        state["vtab"] = {}
+        state["ks"] = {}
+        state["objs"] = None
+        fb = self.delta_stats["fallbacks"]
+        fb[reason] = fb.get(reason, 0) + 1
+        try:
+            from ..metrics import metrics
+            metrics.delta_fallbacks_total.inc(labels={"reason": reason})
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+        log.warning("delta watch stream falling back to object frames "
+                    "(%s)", reason)
+        raise DeltaFallbackError(reason)
+
+    def _apply_patch(self, ev: dict, subs: Dict[str, List], state: dict,
+                     sh: str) -> None:
+        """Apply one column patch onto the mirrored object it names.
+        Validate-then-apply: every field resolves (or the whole frame is
+        refused typed) before any attribute changes, so a refusal leaves
+        the mirror exactly as it was. Caller holds self._lock."""
+        t0 = time.perf_counter()
+        kind = ev["kind"]
+        table = (state["vtab"].get(kind) or {}).get(sh) or ()
+        try:
+            key = table[ev["dk"]]
+        except (IndexError, TypeError):
+            self._delta_fallback(state, "schema_skew")
+        obj = (state["objs"].get(kind) or {}).get(key)
+        if obj is None:
+            # a patch for a key whose add this stream never applied:
+            # continuity is broken even though ks looked dense
+            self._delta_fallback(state, "delta_gap")
+        cls = type(obj)
+        known = known_fields(cls)
+        sets = []
+        try:
+            for fid, wv in zip(ev.get("df") or (), ev.get("dv") or ()):
+                fname = table[fid]
+                if fname not in known:
+                    self._delta_fallback(state, "unknown_field")
+                sets.append((fname, delta_resolve(wv, table)))
+            for fid in ev.get("dx") or ():
+                fname = table[fid]
+                if fname not in known:
+                    self._delta_fallback(state, "unknown_field")
+                sets.append((fname, field_default(cls, fname)))
+        except DeltaFallbackError:
+            raise
+        except IndexError:
+            self._delta_fallback(state, "schema_skew")
+        except (ValueError, TypeError):
+            # undecodable value, or clearing a field with no default
+            self._delta_fallback(state, "schema_skew")
+        t1 = time.perf_counter()
+        # a shallow copy is a faithful ``old``: patches REPLACE field
+        # values, never mutate containers in place, so the copy keeps
+        # every pre-patch reference while the live object moves on
+        old = copy.copy(obj)
+        for fname, val in sets:
+            setattr(obj, fname, val)
+        changed = [fname for fname, _ in sets]
+        for fn in subs.get(kind) or ():
+            if getattr(fn, "delta_aware", False):
+                # delta-aware consumers (SchedulerCache._on_pod) take
+                # the changed-field names and skip the full rebuild
+                fn("update", obj, old, changed)
+            else:
+                fn("update", obj, old)
+        t2 = time.perf_counter()
+        st = self.delta_stats
+        st["events"] += 1
+        st["fields"] += len(sets)
+        st["decode_ms"] += (t1 - t0) * 1000.0
+        st["apply_ms"] += (t2 - t1) * 1000.0
 
     def _resume_watch(self, subs: Dict[str, List], op: str, state: dict,
                       desc: str, endpoint: Optional[tuple] = None):
@@ -945,10 +1194,16 @@ class RemoteClusterStore:
                 # resume is CONTROL-lane regardless of the stream's
                 # original lane: keeping an already-established mirror
                 # consistent outranks admitting new read traffic
-                send_frame(sock, {"op": op, "kinds": list(subs),
-                                  "replay": False, "since": since,
-                                  "prio": "control",
-                                  "client": self.client_id})
+                rreq = {"op": op, "kinds": list(subs),
+                        "replay": False, "since": since,
+                        "prio": "control", "client": self.client_id}
+                if state.get("delta_ask"):
+                    # transport breaks keep the delta ask (the journal
+                    # replay arrives object-form either way; the fresh
+                    # synced re-baselines vtab/ks); typed fallbacks
+                    # cleared the ask and resume plain
+                    rreq["delta"] = True
+                send_frame(sock, rreq)
                 # the missed-event replay lands here, inline
                 self._apply_stream(sock, subs, state, until_synced=True)
             except ResumeGapError as e:

@@ -40,7 +40,9 @@ import hmac
 
 from ..resilience.faultinject import faults
 from ..resilience.overload import AdmissionGate, OverloadedError
-from .codec import decode, encode
+from .codec import (
+    Interner, decode, delta_diff, delta_value, encode, object_key,
+)
 from .store import (
     KINDS, AdmissionError, ClusterStore, ConflictError, FencedError,
     NotFoundError, ReplicaLagError, ReplicaReadOnlyError, ResumeGapError,
@@ -213,6 +215,13 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def recv_frame_sized(sock: socket.socket) -> tuple:
+    """(frame, wire byte length) — the watch client's per-stream byte
+    accounting (volcano_delta_stream_bytes_total) without re-encoding."""
+    raw = recv_frame_raw(sock)
+    return json.loads(raw), len(raw)
+
+
 def recv_frame(sock: socket.socket) -> dict:
     (length,) = struct.unpack("<I", recv_exact(sock, 4))
     if length > MAX_FRAME_BYTES:
@@ -331,6 +340,108 @@ def pump_watch(sock: socket.socket, events: "queue.Queue",
                 on_sent([payload])
         else:
             send_frame(sock, payload)
+
+
+class DeltaEncoder:
+    """Shared per-serving-store builder of delta-form watch payloads
+    (the ``delta: true`` negotiation — see codec.py's dialect notes).
+
+    One instance per store lineage (a StoreServer / shard worker, or one
+    per shard inside the router's watch hub); every call happens under
+    that store's commit lock, so the per-kind frame-sequence counters
+    (``ks``) and the interning table mutate without a lock of their own,
+    and the last-event payload cache lets N delta streams share one
+    diff+dumps exactly like the object path's ``_raw``.
+
+    ``ks`` stamps EVERY live delta-stream frame (patch or object form)
+    densely per kind: the client refuses a gap or repeat BEFORE applying
+    anything, which is what makes the drop/dup fault ladder
+    (``delta_frame``/``delta_frame_dup``) recover with zero lost or
+    duplicated events — the resume replay (object form, journal-fed)
+    starts from a high-water mark the bad frame never advanced."""
+
+    def __init__(self):
+        # one interning table PER KIND: a table addition must ride a
+        # frame of the kind that grew it, and a stream only receives
+        # the kinds it subscribed — a shared table would skew streams
+        # watching a subset of kinds (their copy misses the additions
+        # other kinds' frames carried)
+        self.interners: Dict[str, Interner] = {}
+        self.ks: Dict[str, int] = {}
+        self._last_key: Optional[tuple] = None
+        self._last_payload: Optional[dict] = None
+
+    def payload(self, kind: str, shard, rv: int, event: str,
+                obj, old) -> dict:
+        cache_key = (kind, rv, event, id(obj))
+        if cache_key == self._last_key:
+            return self._last_payload  # type: ignore[return-value]
+        n = self.ks.get(kind, 0) + 1
+        self.ks[kind] = n
+        payload: dict = {"stream": "event", "kind": kind, "rv": rv,
+                         "event": event, "ks": n}
+        if shard is not None:
+            payload["shard"] = shard
+        it = self.interners.get(kind)
+        if it is None:
+            it = self.interners[kind] = Interner()
+        t0 = len(it.entries)
+        patched = False
+        if event == "update" and old is not None:
+            enc_new, enc_old = encode(obj), encode(old)
+            d = delta_diff(enc_new, enc_old)
+            if d is not None:
+                changed, cleared = d
+                dk = it.intern(object_key(obj))
+                if dk is not None:
+                    df, dv, dx = [], [], []
+                    ok = True
+                    for fname, enc in changed.items():
+                        fid = it.intern(fname)
+                        if fid is None:
+                            ok = False  # table at cap: object form
+                            break
+                        df.append(fid)
+                        dv.append(delta_value(enc, it))
+                    if ok:
+                        for fname in cleared:
+                            fid = it.intern(fname)
+                            if fid is None:
+                                ok = False
+                                break
+                            dx.append(fid)
+                    if ok:
+                        payload["dk"] = dk
+                        payload["df"] = df
+                        payload["dv"] = dv
+                        if dx:
+                            payload["dx"] = dx
+                        patched = True
+        if not patched:
+            payload["obj"] = encode(obj)
+            payload["old"] = encode(old) if old is not None else None
+        added = it.entries[t0:]
+        if added:
+            # the table entries THIS event created ride this frame, in
+            # id order — every subscribed stream needs exactly these
+            # (its synced snapshot covered everything earlier)
+            payload["tb"] = [t0, added]
+        payload["_raw"] = json.dumps(payload, separators=(",", ":"))
+        self._last_key = cache_key
+        self._last_payload = payload
+        return payload
+
+    def synced_fields(self, kinds, shard) -> dict:
+        """The delta half of a stream's ``synced`` frame — per-kind
+        table snapshots plus per-kind ks baselines, read under the
+        store lock so they are atomic with the subscription. Only the
+        subscribed kinds ship: their frames are all this stream will
+        see, so their tables are all it can keep aligned."""
+        sh = str(shard if shard is not None else 0)
+        return {"delta": True,
+                "vtab": {k: {sh: self.interners[k].snapshot()}
+                         for k in kinds if k in self.interners},
+                "ks": {k: {sh: self.ks.get(k, 0)} for k in kinds}}
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -609,6 +720,17 @@ class _Handler(socketserver.BaseRequestHandler):
         shard = getattr(self.server, "shard_tag", None)
         journal: Optional[EventJournal] = getattr(self.server, "journal",
                                                   None)
+        # delta negotiation: additive and fail-safe — the client must ask
+        # (delta: true) AND this server must carry an encoder; otherwise
+        # the stream is plain object frames exactly as before
+        enc: Optional[DeltaEncoder] = getattr(self.server, "delta_enc",
+                                              None)
+        delta = bool(req.get("delta")) and enc is not None
+        # replay adds (store.watch replay / journal resume) are delivered
+        # synchronously under the subscribe hold, BEFORE this flips: they
+        # stay object frames without ks, because the shared encoder's
+        # counters must only move for live events every delta stream sees
+        sync_done = [False]
         # bounded queue + send timeout: a peer that stalls without closing
         # (TCP zero window) otherwise blocks the writer in sendall forever
         # while the listeners keep enqueueing — unbounded memory per stuck
@@ -630,6 +752,22 @@ class _Handler(socketserver.BaseRequestHandler):
         def listener_for(kind):
             def listener(event, obj, old):
                 # under the store lock: store._rv is this event's rv
+                if delta and sync_done[0]:
+                    payload = enc.payload(kind, shard, store._rv,
+                                          event, obj, old)
+                    try:
+                        faults.fire("delta_frame")
+                    except Exception:  # noqa: BLE001 — injected drop
+                        # frame lost AFTER its ks was consumed: the
+                        # client sees the gap on the next frame and
+                        # falls back typed (delta_gap)
+                        return
+                    enqueue(payload)
+                    try:
+                        faults.fire("delta_frame_dup")
+                    except Exception:  # noqa: BLE001 — injected dup
+                        enqueue(payload)  # same ks twice: typed refusal
+                    return
                 payload = {"stream": "event", "kind": kind,
                            "rv": store._rv, "event": event,
                            "obj": encode(obj),
@@ -672,11 +810,18 @@ class _Handler(socketserver.BaseRequestHandler):
                         listeners.append((kind, listener))
                         store.watch(kind, listener,
                                     replay=replay and since is None)
-                    enqueue({"stream": "synced",
-                             "rv": {k: ({str(shard): store.last_event_rv(k)}
-                                        if shard is not None
-                                        else store.last_event_rv(k))
-                                    for k in kinds}})
+                    sync_done[0] = True
+                    sync_payload = {
+                        "stream": "synced",
+                        "rv": {k: ({str(shard): store.last_event_rv(k)}
+                                   if shard is not None
+                                   else store.last_event_rv(k))
+                               for k in kinds}}
+                    if delta:
+                        # table snapshot + per-kind ks baselines, atomic
+                        # with the subscription under this same hold
+                        sync_payload.update(enc.synced_fields(kinds, shard))
+                    enqueue(sync_payload)
             if gap_kind is not None:
                 send_frame(sock, {
                     "ok": False, "error": "ResumeGapError",
@@ -876,6 +1021,11 @@ class StoreServer:
         # the shard router builds one journal per shard instead)
         self.journal = self._make_journal(store)
         self._server.journal = self.journal  # type: ignore[attr-defined]
+        # delta-watch encoder for this store lineage: one interning table
+        # + per-kind frame counters shared by every delta: true stream
+        # (the shard ROUTER serves watches through its hub's per-shard
+        # encoders instead — _RouterHandler overrides _serve_watch)
+        self._server.delta_enc = DeltaEncoder()  # type: ignore[attr-defined]
         # live connection sockets, so stop() drops watch streams too
         # (daemon handler threads outlive server_close otherwise and
         # clients would never learn the server is gone)

@@ -70,8 +70,8 @@ from ..resilience.faultinject import faults
 from .codec import encode
 from .durable import DurableClusterStore
 from .server import (
-    WATCH_BATCH_MAX, WATCH_QUEUE_MAX, WATCH_SEND_TIMEOUT_S, EventJournal,
-    StoreServer, _Handler, pump_watch, send_frame,
+    WATCH_BATCH_MAX, WATCH_QUEUE_MAX, WATCH_SEND_TIMEOUT_S, DeltaEncoder,
+    EventJournal, StoreServer, _Handler, pump_watch, send_frame,
 )
 from .store import (
     KINDS, ClusterStore, ShardUnavailableError, _key,
@@ -551,38 +551,77 @@ class _WatchHub:
 
     def __init__(self, store: ShardedClusterStore):
         self.store = store
+        #: per kind: [(enqueue, delta), ...] — one row per watch stream
         self._subs: Dict[str, List] = {k: [] for k in KINDS}
         self._attached: set = set()
+        # one delta encoder per member shard, created eagerly: each owns
+        # that shard's interning table + per-kind frame counters, mutated
+        # only under the shard's commit notify (so no extra lock), and a
+        # delta stream's synced snapshot covers every shard even before
+        # the first event flows
+        self.delta_encs = [DeltaEncoder() for _ in range(store.n_shards)]
 
-    def subscribe(self, kind: str, enqueue) -> None:
+    def subscribe(self, kind: str, enqueue, delta: bool = False) -> None:
         # caller holds store.locked(): the subscription is atomic with
         # the replay it just enqueued
         if kind not in self._attached:
             self._attached.add(kind)
             self.store.watch_sharded(kind, self._fan(kind), replay=False)
-        self._subs[kind].append(enqueue)
+        self._subs[kind].append((enqueue, delta))
 
     def unsubscribe(self, kind: str, enqueue) -> None:
-        try:
-            self._subs[kind].remove(enqueue)
-        except ValueError:
-            pass
+        self._subs[kind] = [s for s in self._subs[kind]
+                            if s[0] is not enqueue]
+
+    def synced_fields(self, kinds) -> dict:
+        """The delta half of a stream's ``synced`` frame: per-kind,
+        per-shard table snapshots + per-kind/per-shard ks baselines.
+        Caller holds ``store.locked()`` so they are atomic with the
+        subscription."""
+        vtab: Dict[str, dict] = {}
+        ks: Dict[str, Dict[str, int]] = {k: {} for k in kinds}
+        for idx, enc in enumerate(self.delta_encs):
+            for k in kinds:
+                it = enc.interners.get(k)
+                if it is not None:
+                    vtab.setdefault(k, {})[str(idx)] = it.snapshot()
+                ks[k][str(idx)] = enc.ks.get(k, 0)
+        return {"delta": True, "vtab": vtab, "ks": ks}
 
     def _fan(self, kind: str):
         def fn(shard, rv, event, obj, old):
             subs = self._subs[kind]
             if not subs:
                 return  # zero watchers: zero encodes
-            payload = {"stream": "event", "kind": kind, "shard": shard,
-                       "rv": rv, "event": event, "obj": encode(obj),
-                       "old": encode(old) if old is not None else None}
-            # serialize ONCE: every stream ships these same bytes
-            # (pump_watch), so an extra watcher costs a queue append
-            # and a socket write, not another encode+dumps
-            payload["_raw"] = json.dumps(payload,
-                                         separators=(",", ":"))
-            for enq in list(subs):
-                enq(payload)
+            obj_subs = [s[0] for s in subs if not s[1]]
+            delta_subs = [s[0] for s in subs if s[1]]
+            if obj_subs:
+                payload = {"stream": "event", "kind": kind, "shard": shard,
+                           "rv": rv, "event": event, "obj": encode(obj),
+                           "old": encode(old) if old is not None else None}
+                # serialize ONCE: every stream ships these same bytes
+                # (pump_watch), so an extra watcher costs a queue append
+                # and a socket write, not another encode+dumps
+                payload["_raw"] = json.dumps(payload,
+                                             separators=(",", ":"))
+                for enq in obj_subs:
+                    enq(payload)
+            if delta_subs:
+                dp = self.delta_encs[shard].payload(
+                    kind, shard, rv, event, obj, old)
+                try:
+                    faults.fire("delta_frame")
+                except Exception:  # noqa: BLE001 — injected drop
+                    # the frame's ks was consumed but it never ships:
+                    # every delta stream sees the gap and falls back
+                    return
+                for enq in delta_subs:
+                    enq(dp)
+                try:
+                    faults.fire("delta_frame_dup")
+                except Exception:  # noqa: BLE001 — injected dup
+                    for enq in delta_subs:
+                        enq(dp)  # same ks twice: typed refusal
         return fn
 
 
@@ -647,6 +686,10 @@ class _RouterHandler(_Handler):
             journals.dropped(counts)
 
         hub: _WatchHub = self.server.hub  # type: ignore[attr-defined]
+        # delta negotiation (fail-safe: object frames unless asked).
+        # Replay adds below bypass the hub and stay object frames; only
+        # live hub events ship delta-form with ks stamps
+        delta = bool(req.get("delta"))
         hooked = []
         try:
             gap = None  # (kind, message)
@@ -698,12 +741,15 @@ class _RouterHandler(_Handler):
                                              "rv": rv, "event": "add",
                                              "obj": encode(obj),
                                              "old": None})
-                        hub.subscribe(kind, enqueue)
+                        hub.subscribe(kind, enqueue, delta=delta)
                         hooked.append(kind)
-                    enqueue({"stream": "synced", "rv": {
+                    sync_payload = {"stream": "synced", "rv": {
                         k: {str(i): store.shards[i].last_event_rv(k)
                             for i in range(store.n_shards)}
-                        for k in kinds}})
+                        for k in kinds}}
+                    if delta:
+                        sync_payload.update(hub.synced_fields(kinds))
+                    enqueue(sync_payload)
             if gap is not None:
                 send_frame(sock, {
                     "ok": False, "error": "ResumeGapError",

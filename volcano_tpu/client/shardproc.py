@@ -1204,6 +1204,10 @@ class _ProcRouterHandler(_Handler):
                 upstreams.append(usock)
                 ureq: dict = {"op": req.get("op", "watch"),
                               "kinds": kinds, "replay": replay}
+                if req.get("delta"):
+                    # forward the delta ask verbatim: workers emit the
+                    # delta frames; this relay stays byte-verbatim
+                    ureq["delta"] = True
                 if since is not None:
                     ureq["replay"] = False
                     ureq["since"] = {
@@ -1216,6 +1220,13 @@ class _ProcRouterHandler(_Handler):
             # merged {kind: {shard: rv}} marker (the client returns from
             # its inline replay at the first synced it sees)
             synced_rv: Dict[str, Dict[str, Any]] = {k: {} for k in kinds}
+            # delta merge: every worker must have negotiated delta for
+            # the merged stream to be delta (fail-safe: one old worker
+            # quietly demotes the whole stream to object frames — the
+            # client simply sees its ask declined)
+            delta_ok = bool(req.get("delta"))
+            synced_vtab: Dict[str, dict] = {}
+            synced_ks: Dict[str, Dict[str, int]] = {k: {} for k in kinds}
             for i, usock in enumerate(upstreams):
                 while True:
                     raw = recv_frame_raw(usock)
@@ -1230,11 +1241,29 @@ class _ProcRouterHandler(_Handler):
                                 synced_rv.setdefault(k, {}).update(val)
                             else:
                                 synced_rv.setdefault(k, {})[str(i)] = val
+                        if delta_ok:
+                            if msg.get("delta"):
+                                # vtab is {kind: {shard: entries}} and
+                                # workers own disjoint shards, so the
+                                # per-kind inner maps merge cleanly
+                                for k, m in (msg.get("vtab")
+                                             or {}).items():
+                                    synced_vtab.setdefault(
+                                        k, {}).update(m)
+                                for k, m in (msg.get("ks") or {}).items():
+                                    synced_ks.setdefault(k, {}).update(m)
+                            else:
+                                delta_ok = False
                         break
                     if stream in ("event", "events"):
                         send_frame_raw(sock, raw)
                     # heartbeats are dropped during the open phase
-            send_frame(sock, {"stream": "synced", "rv": synced_rv})
+            merged: dict = {"stream": "synced", "rv": synced_rv}
+            if delta_ok:
+                merged["delta"] = True
+                merged["vtab"] = synced_vtab
+                merged["ks"] = synced_ks
+            send_frame(sock, merged)
             # phase 2: pure byte relay — N reader threads feed one
             # writer (this thread), which serializes frames onto the
             # client socket

@@ -343,6 +343,41 @@ order_fallbacks_total = registry.register(Counter(
     "mismatch, conf_reload, key_context, session_mutations, queue_"
     "membership, comparator_only, ...)", ["reason"]))
 
+# -- delta watch metrics (client/codec.py delta dialect, client/remote.py) --
+
+delta_frames_total = registry.register(Counter(
+    "volcano_delta_frames_total",
+    "Wire frames received on negotiated delta watch streams (patch and "
+    "interleaved object frames alike)"))
+delta_patches_applied_total = registry.register(Counter(
+    "volcano_delta_patches_applied_total",
+    "Column-patch events applied straight onto mirrored objects (no "
+    "full-object decode)"))
+delta_fields_applied_total = registry.register(Counter(
+    "volcano_delta_fields_applied_total",
+    "Individual field writes applied by column patches"))
+delta_stream_bytes_total = registry.register(Counter(
+    "volcano_delta_stream_bytes_total",
+    "Watch-stream wire bytes by mode: delta = frames on a negotiated "
+    "delta stream, object = plain object frames — the like-for-like "
+    "bytes comparison between the two paths", ["mode"]))
+delta_decode_ms = registry.register(Gauge(
+    "volcano_delta_decode_milliseconds",
+    "Cumulative wall time resolving patch columns (table lookups + raw-"
+    "value decodes) on this client's delta streams"))
+delta_apply_ms = registry.register(Gauge(
+    "volcano_delta_apply_milliseconds",
+    "Cumulative wall time applying resolved patches (field writes + "
+    "listener dispatch) on this client's delta streams"))
+delta_vocab_size = registry.register(Gauge(
+    "volcano_delta_vocab_size",
+    "Peak interning-table size across this client's delta streams "
+    "(capped at codec.DELTA_VOCAB_MAX; overflow falls back typed)"))
+delta_fallbacks_total = registry.register(Counter(
+    "volcano_delta_fallbacks_total",
+    "Typed delta-stream fallbacks to the object path, by reason (delta_"
+    "gap, vocab_overflow, unknown_field, schema_skew)", ["reason"]))
+
 # -- resilience metrics (resilience/, scheduler containment, store client) --
 
 breaker_state = registry.register(Gauge(

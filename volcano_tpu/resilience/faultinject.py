@@ -107,7 +107,18 @@ overload tests arm against a live server), and ``request_deadline``
 EXPIRED ON ARRIVAL exactly as if its ``deadline_ms`` wire header had
 already lapsed: counted in
 volcano_store_admission_deadline_expired_total and refused typed
-without burning a dispatch thread).
+without burning a dispatch thread), ``delta_frame`` (client/server.py
+delta-negotiated watch listener, after the column patch consumed its
+per-kind frame sequence number and before the frame enqueues — an
+armed firing DROPS the frame; the client's dense-``ks`` check refuses
+the NEXT frame of that stream before applying anything, falls back
+typed (``delta_gap``), and resumes on object frames from the
+high-water mark the lost frame never advanced — zero lost events),
+and ``delta_frame_dup`` (same seam, after the enqueue — an armed
+firing enqueues the frame a SECOND time; the repeated ``ks`` is
+refused immediately, same typed fallback, zero duplicated events;
+object-form streams never pass this seam, so the blast radius is
+exactly the delta dialect).
 """
 
 from __future__ import annotations
