@@ -2745,7 +2745,16 @@ def read_replica_fanout():
     (p50/p99, reported honestly). ``ok`` enforces the ISSUE bound:
     with the storm routed to replicas the scheduler's cycle p50
     stretches <= 1.05x idle (the primary-only arm records its own
-    degradation for contrast)."""
+    degradation for contrast).
+
+    The ``tree_depth2`` arm (ISSUE 17) rebuilds the rig as a fan-out
+    TREE — primary -> r1 -> (r2a, r2b) — with a 10x watcher storm on
+    the leaves, the scheduler reading from a leaf via ReadTierStore,
+    and two writer phases (no-storm, under-storm) whose events/sec
+    ratio is the flatness signal; ``tree_ok`` additionally demands
+    byte-identical mirrors at every depth, zero primary read-lane
+    requests for tree traffic, and replica-fed scheduler binds
+    identical to the primary-fed golden."""
     import os
     import shutil
     import subprocess
@@ -2976,6 +2985,262 @@ def read_replica_fanout():
                 arm["replica_lag_records_p50"] = pct(lag_samples, 50)
                 arm["replica_lag_records_p99"] = pct(lag_samples, 99)
                 arm["replica_caught_up"] = drained()
+            # the bench workload's bind map: the cross-arm golden for
+            # the tree arm's scheduler-off-the-primary decisions
+            arm["binds"] = {p.name: p.node_name
+                            for p in seed.list("pods", namespace="bench")}
+            return arm
+        finally:
+            for c in clients:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            for proc in procs:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            shutil.rmtree(work, ignore_errors=True)
+
+    def tree_arm():
+        """The depth-2 fan-out tree (ISSUE 17): primary -> r1 ->
+        (r2a, r2b), a 10x watcher storm (vs the ISSUE-12 floor) landing
+        ONLY on the leaves, the scheduler reading from a leaf through a
+        ReadTierStore (mutations still to the primary), and the
+        primary's own per-op request counters as the ground truth that
+        the tree absorbed every read. Two writer phases — no-storm,
+        then under-storm — make the writer-throughput stretch direct;
+        per-depth staleness is sampled against the primary's rv."""
+        from volcano_tpu.cache import FakeEvictor, SchedulerCache
+        from volcano_tpu.client.codec import encode as _enc
+        from volcano_tpu.client.readtier import ReadTierStore
+        from volcano_tpu.scheduler import Scheduler
+
+        TREE_WATCHERS = WATCHERS * 10
+        TREE_WAVE = 150          # 2 writers x (create+update) per phase
+        work = tempfile.mkdtemp(prefix="volcano-tree-bench-")
+        pport = free_port()
+        server = start_store_proc(pport, os.path.join(work, "pdata"),
+                                  fsync="off")
+        addr = f"127.0.0.1:{pport}"
+        arm = {"tree": "primary->r1->(r2a,r2b)",
+               "watchers_target": TREE_WATCHERS}
+        clients = []
+        procs = [server]
+
+        def client(a=addr, **kw):
+            c = RemoteClusterStore(a, **kw)
+            clients.append(c)
+            return c
+
+        def ready_parts(proc, what, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if line.startswith("READY"):
+                    return line.split()
+                if proc.poll() is not None:
+                    break
+            raise RuntimeError(f"{what} failed to start")
+
+        def start_replica(upstream):
+            rport = free_port()
+            rp = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(TESTS, "replica_proc.py"),
+                 "--primary", upstream, "--port", str(rport)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=os.path.dirname(TESTS))
+            ready_parts(rp, f"replica@{upstream}", 180)
+            procs.append(rp)
+            return f"127.0.0.1:{rport}"
+
+        def run_writers(writer_ids):
+            ws = []
+            for w in writer_ids:
+                wp = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(TESTS, "store_churn_proc.py"),
+                     "--addr", addr, "--writer", str(w),
+                     "--waves", "1", "--wave-size", str(TREE_WAVE)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True, cwd=os.path.dirname(TESTS))
+                ready_parts(wp, f"writer {w}", 60)
+                procs.append(wp)
+                ws.append(wp)
+            t0 = time.perf_counter()
+            for wp in ws:
+                wp.stdin.write("GO\n")
+                wp.stdin.flush()
+            applied = 0
+            for wp in ws:
+                applied += int(wp.stdout.readline().split()[1])
+                wp.wait(timeout=300)
+            return applied, time.perf_counter() - t0
+
+        try:
+            seed = client()
+            seed.apply("queues", build_queue("q0", weight=1))
+            for i in range(8):
+                seed.apply("nodes", build_node(
+                    f"n{i}", {"cpu": "32", "memory": "128Gi"}))
+            for j in range(4):
+                seed.apply("podgroups", build_pod_group(
+                    f"job{j}", "bench", min_member=2, queue="q0"))
+                for i in range(2):
+                    seed.create("pods", build_pod(
+                        "bench", f"job{j}-{i}", "", "Pending",
+                        {"cpu": "1", "memory": "1Gi"}, f"job{j}"))
+
+            r1 = start_replica(addr)
+            r2a = start_replica(r1)
+            r2b = start_replica(r1)
+            info_p = client()
+            info_by_depth = {1: [client(r1)],
+                             2: [client(r2a), client(r2b)]}
+
+            def rv_of(c):
+                return rv_scalar(c._request({"op": "store_info"})["rv"])
+
+            def tree_caught_up():
+                try:
+                    prv = rv_of(info_p)
+                    return all(rv_of(c) == prv
+                               for cs in info_by_depth.values()
+                               for c in cs)
+                except Exception:  # noqa: BLE001
+                    return False
+
+            deadline = time.time() + 120
+            while not tree_caught_up() and time.time() < deadline:
+                time.sleep(0.05)
+
+            # -- the scheduler rides the READ TIER: list/watch from a
+            # leaf, binds to the primary, read-your-writes via min_rv
+            rt = ReadTierStore(client(), client(r2a))
+            cache = SchedulerCache(rt)
+            cache.evictor = FakeEvictor()
+            cache.run()
+            cache.wait_for_cache_sync()
+            sched = Scheduler(cache)
+
+            def all_bound():
+                pods = seed.list("pods", namespace="bench")
+                return pods and all(p.node_name for p in pods)
+
+            deadline = time.time() + 120
+            while not all_bound() and time.time() < deadline:
+                sched.run_once()
+                time.sleep(0.05)
+            arm["binds"] = {p.name: p.node_name
+                            for p in seed.list("pods",
+                                               namespace="bench")}
+            arm["scheduler_reads_replica"] = rt.reads_replica
+            arm["scheduler_read_fallbacks"] = rt.read_fallbacks
+
+            # -- phase 1: writers with the tree attached, NO storm
+            applied0, dt0 = run_writers((0, 1))
+            arm["writer_events_per_sec_no_storm"] = round(applied0 / dt0)
+
+            # read-lane ground truth from here on: the storm phase must
+            # add ZERO of these on the primary
+            def read_lane():
+                reqs = (info_p._request({"op": "store_info"})
+                        .get("requests") or {})
+                return {op: int(reqs.get(op, 0))
+                        for op in ("list", "get", "watch", "bulk_watch")}
+
+            lane0 = read_lane()
+
+            # -- the storm: TREE_WATCHERS split across the two leaves
+            storms = []
+            watchers_live = 0
+            for t in (r2a, r2b):
+                sp = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(TESTS, "watch_storm_proc.py"),
+                     "--addr", t,
+                     "--watchers", str(TREE_WATCHERS // 2),
+                     "--list-threads", "2"],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True, cwd=os.path.dirname(TESTS))
+                parts = ready_parts(sp, "tree watch storm", 300)
+                watchers_live += int(parts[1])
+                procs.append(sp)
+                storms.append(sp)
+            arm["watchers_live"] = watchers_live
+
+            lag = {1: [], 2: []}
+            stop = threading.Event()
+
+            def sample_lag():
+                while not stop.is_set():
+                    try:
+                        prv = rv_of(info_p)
+                        for depth, cs in info_by_depth.items():
+                            for c in cs:
+                                lag[depth].append(
+                                    max(0, prv - rv_of(c)))
+                    except Exception:  # noqa: BLE001 — sampling only
+                        pass
+                    stop.wait(0.05)
+
+            sampler = threading.Thread(target=sample_lag)
+            sampler.start()
+            for sp in storms:
+                sp.stdin.write("GO\n")
+                sp.stdin.flush()
+            # -- phase 2: the same writer volume under the tree storm
+            applied1, dt1 = run_writers((2, 3))
+            arm["writer_events_per_sec_storm"] = round(applied1 / dt1)
+            arm["writer_stretch"] = (
+                round((applied0 / dt0) / (applied1 / dt1), 3)
+                if applied1 else None)
+
+            # in-storm drain: a capable rig catches the primary with
+            # all 2000 watchers still subscribed; a 1-core host can
+            # legitimately still be fanning deliveries out, so after
+            # the grace window release the storm and require full
+            # catch-up (zero lost records) before the identity check
+            deadline = time.time() + 60
+            while not tree_caught_up() and time.time() < deadline:
+                time.sleep(0.05)
+            arm["tree_caught_up_in_storm"] = tree_caught_up()
+            stop.set()
+            sampler.join()
+            events = 0
+            for sp in storms:
+                sp.stdin.write("STOP\n")
+                sp.stdin.flush()
+                events += int(sp.stdout.readline().split()[1])
+                sp.wait(timeout=60)
+            arm["read_tier_events"] = events
+            deadline = time.time() + 180
+            while not tree_caught_up() and time.time() < deadline:
+                time.sleep(0.05)
+            arm["tree_caught_up"] = tree_caught_up()
+            for depth in (1, 2):
+                arm[f"lag_records_depth{depth}_p50"] = pct(lag[depth], 50)
+                arm[f"lag_records_depth{depth}_p99"] = pct(lag[depth], 99)
+            lane1 = read_lane()
+            arm["primary_read_lane_delta"] = {
+                op: lane1[op] - lane0[op] for op in lane1}
+            arm["primary_read_lane_zero"] = all(
+                v == 0 for v in arm["primary_read_lane_delta"].values())
+
+            # -- byte identity: every mirror in the tree vs the primary
+            def wire_dump(c):
+                objs = sorted(c.list("pods"),
+                              key=lambda o: ((o.namespace or ""), o.name))
+                return [_enc(o) for o in objs]
+
+            golden = wire_dump(info_p)
+            arm["pods_total"] = len(golden)
+            arm["mirrors_identical"] = all(
+                wire_dump(c) == golden
+                for cs in info_by_depth.values() for c in cs)
             return arm
         finally:
             for c in clients:
@@ -3001,6 +3266,8 @@ def read_replica_fanout():
         out["arms"][label] = _run_config(
             f"read_replica_fanout[{label}]",
             lambda n=n_replicas, p=proc: one_arm(n, proc_primary=p))
+    out["arms"]["tree_depth2"] = _run_config(
+        "read_replica_fanout[tree_depth2]", tree_arm)
     r1 = out["arms"].get("replicas_1", {})
     r0 = out["arms"].get("replicas_0", {})
     r1p = out["arms"].get("replicas_1_proc", {})
@@ -3020,15 +3287,38 @@ def read_replica_fanout():
         "floor_cycle_stretch": 1.05,
         "met": bool((r1.get("cycle_stretch") or 9) <= 1.05),
     }
+    tree = out["arms"].get("tree_depth2", {})
+    tree_floors = {
+        "tree_writer_stretch": tree.get("writer_stretch"),
+        "floor_writer_stretch": 1.10,
+        "tree_stretch_met": bool(
+            (tree.get("writer_stretch") or 9) <= 1.10),
+    }
     capable_rig = (out["cpu_count"] or 1) >= 4
-    out["core_bound"] = None if capable_rig else floors
+    out["core_bound"] = (None if capable_rig
+                         else {**floors, **tree_floors})
     out["proc_arm_ok"] = bool(
         r1p.get("replica_caught_up")
         and ((r1p.get("cycle_stretch") or 9) <= 1.05
              or not capable_rig))
+    # the ISSUE-17 tree gate: the depth-2 tree absorbed a 10x storm —
+    # every mirror byte-identical, the primary served ZERO read-lane
+    # requests for it, the scheduler's replica-fed decisions match the
+    # primary-fed golden — with the writer-flatness floor gated on
+    # rigs with the cores to isolate the tree's processes
+    out["tree_binds_match_golden"] = bool(
+        tree.get("binds") and tree.get("binds") == r0.get("binds"))
+    out["tree_ok"] = bool(
+        tree.get("tree_caught_up")
+        and tree.get("mirrors_identical")
+        and tree.get("primary_read_lane_zero")
+        and (tree.get("watchers_live") or 0) >= WATCHERS * 10
+        and out["tree_binds_match_golden"]
+        and (tree_floors["tree_stretch_met"] or not capable_rig))
     out["ok"] = bool(
         r1.get("replica_caught_up")
         and (r1.get("watchers") or 0) >= 200
+        and out["tree_ok"]
         and (floors["met"] or not capable_rig))
     return out
 

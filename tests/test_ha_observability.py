@@ -124,6 +124,13 @@ class TestMetricsServer:
             body = urllib.request.urlopen(f"{base}/metrics").read().decode()
             assert "volcano_schedule_attempts_total" in body
             assert "volcano_e2e_scheduling_latency_milliseconds" in body
+            # read-tier (fan-out tree) families: a replica's place in
+            # the chain and the ship traffic it re-serves downstream
+            assert "volcano_replica_upstream_depth" in body
+            assert "volcano_replica_upstream_rv" in body
+            assert "volcano_replica_ship_served_streams" in body
+            assert "volcano_replica_ship_served_records_total" in body
+            assert "volcano_replica_ship_served_bootstraps_total" in body
             assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
             stacks = urllib.request.urlopen(
                 f"{base}/debug/stacks").read().decode()

@@ -349,6 +349,73 @@ def _admission_table(lanes: dict) -> str:
          "DeadlineExp", "ShedReasons", "Limits(i/q/s)"], rows)
 
 
+def _fmt_rv(rv) -> str:
+    if isinstance(rv, dict):
+        return ",".join(f"{sh}:{v}" for sh, v in sorted(rv.items()))
+    return str(rv)
+
+
+def _replica_chain_table(rinfo: dict, cluster) -> str:
+    """Walk a replica's upstream chain hop by hop (replica_info on each
+    parent until the primary answers store_info) and render one row per
+    hop — the tree-debugging view: who feeds whom, how far behind, and
+    how many re-bootstraps each hop has absorbed."""
+    from ..client.remote import RemoteClusterStore
+    rows = []
+
+    def add_row(endpoint: str, info: dict) -> None:
+        per = info.get("per_shard") or {}
+        lag_r = ",".join(str(per[s].get("lag_records"))
+                         for s in sorted(per)) or "-"
+        lag_s = ",".join(
+            "-" if per[s].get("lag_seconds") is None
+            else f"{per[s]['lag_seconds']:.1f}"
+            for s in sorted(per)) or "-"
+        boots = ",".join(
+            f"{k}:{v}" for k, v in
+            sorted((info.get("bootstraps") or {}).items())) or "-"
+        served = ",".join(
+            f"{k}:{v}" for k, v in
+            sorted((info.get("ship_served") or {}).items())) or "-"
+        rows.append([str(info.get("depth", "?")), endpoint,
+                     _fmt_rv(info.get("applied_rv")), lag_r, lag_s,
+                     boots, served])
+
+    add_row(f"{cluster.host}:{cluster.port}", rinfo)
+    token = getattr(cluster, "token", "") or None
+    upstream = rinfo.get("upstream")
+    hops = 0
+    while upstream and hops < 8:  # defensive: a cycle must not spin
+        hops += 1
+        c = None
+        try:
+            c = RemoteClusterStore(upstream, token=token,
+                                   direct_routing=False,
+                                   retry_attempts=1)
+            try:
+                uinfo = c._request({"op": "replica_info"})
+            except Exception:  # noqa: BLE001 — not a replica: primary?
+                uinfo = None
+            if uinfo and uinfo.get("ok"):
+                add_row(upstream, uinfo)
+                upstream = uinfo.get("upstream")
+                continue
+            sinfo = c._request({"op": "store_info"})
+            rows.append(["0", upstream, _fmt_rv(sinfo.get("rv")),
+                         "-", "-", "-", "primary"])
+            upstream = None
+        except Exception as e:  # noqa: BLE001 — best-effort rendering
+            rows.append(["?", upstream, "unreachable", "-", "-", "-",
+                         f"{type(e).__name__}"])
+            upstream = None
+        finally:
+            if c is not None:
+                c.close()
+    return _table(
+        ["Depth", "Endpoint", "AppliedRv", "Lag(rec)", "Lag(s)",
+         "Bootstraps", "ShipServed"], rows)
+
+
 def status_cmd(args, cluster: ClusterStore) -> str:
     """Control-plane store status: shape, durability, rv(s) — for a
     multi-process sharded deployment, the shard map with per-worker
@@ -396,6 +463,13 @@ def status_cmd(args, cluster: ClusterStore) -> str:
             + "\n(shards share the server process; no direct endpoints)")
     else:
         lines.append(f"rv: {rv}")
+    try:
+        rinfo = req({"op": "replica_info"})
+    except Exception:  # noqa: BLE001 — not a replica endpoint
+        rinfo = None
+    if rinfo and rinfo.get("ok"):
+        lines.append("replica upstream chain (this endpoint first):")
+        lines.append(_replica_chain_table(rinfo, cluster))
     try:
         adm = req({"op": "admission_info"})
     except Exception:  # noqa: BLE001 — pre-admission (old) server

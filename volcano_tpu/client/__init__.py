@@ -8,6 +8,7 @@ from ..resilience.overload import (  # noqa: F401
     AdmissionGate, OverloadedError, RetryBudget, RetryBudgetExhausted,
 )
 from .durable import DurableClusterStore, WriteAheadLog  # noqa: F401
+from .readtier import ReadTierStore  # noqa: F401
 from .remote import RemoteClusterStore  # noqa: F401
 from .replica import (  # noqa: F401
     ReplicaGapError, ReplicaServer, ReplicaStore, ShardedReplicaServer,

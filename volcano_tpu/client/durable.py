@@ -241,6 +241,12 @@ class DurableClusterStore(ClusterStore):
     ``--store-data-dir``; construction IS recovery (an empty directory
     recovers to an empty store)."""
 
+    #: this store can feed a replica: it exposes the ship interface
+    #: (ship_floor / add_ship_listener / newest_snapshot_state). The
+    #: replica mirror sets the same flag — a replica can re-serve its
+    #: applied stream to a deeper replica (client/replica.py)
+    ship_capable = True
+
     def __init__(self, data_dir: str, fsync: str = "every",
                  fsync_interval_s: float = 0.05,
                  snapshot_every: int = SNAPSHOT_EVERY_RECORDS,

@@ -55,6 +55,10 @@ log = logging.getLogger(__name__)
 BULK_CHUNK_BYTES = 8 << 20
 BULK_CHUNK_ITEMS = 2048
 
+#: wire ops whose responses carry an ``applied_rv`` stamp this client
+#: folds into its read-your-writes high-water mark (applied_hwm)
+_MUTATING_WIRE_OPS = ("create", "update", "apply", "delete", "bulk_apply")
+
 
 class DeltaFallbackError(ValueError):
     """Typed refusal of a delta watch frame (the reason is ``args[0]``:
@@ -125,7 +129,8 @@ class RemoteClusterStore:
                  lane: Optional[str] = None,
                  op_deadline_ms: float = 0.0,
                  retry_budget: Optional[RetryBudget] = None,
-                 delta_watch: bool = False):
+                 delta_watch: bool = False,
+                 read_from_replicas: bool = False):
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -201,6 +206,22 @@ class RemoteClusterStore:
         self._shard_endpoints: List[tuple] = []
         self.direct_requests = 0    # requests sent straight to a shard
         self.direct_fallbacks = 0   # direct failures re-run via router
+        # -- read-tier routing (replica fan-out trees) ------------------
+        # opt-in: topology's read_endpoints table names announced
+        # replicas; idempotent reads prefer the deepest one, stamped
+        # min_rv=applied_hwm() so read-your-writes holds, with typed/
+        # unreachable fallback to the primary
+        self.read_from_replicas = bool(read_from_replicas)
+        self._read_endpoints: List[dict] = []
+        self._read_client: Optional["RemoteClusterStore"] = None
+        self._read_cooldown = 0.0
+        self.read_tier_reads = 0      # reads served by the read tier
+        self.read_tier_fallbacks = 0  # reads that fell back primary-side
+        # rv high-water mark across this client's OWN acked mutations
+        # ({shard: rv}; "0" for an unsharded primary) — the min_rv bound
+        # a read-your-writes read against a replica must demand
+        self._applied_hwm: Dict[str, int] = {}
+        self._applied_hwm_mapform = False
         self._watch_threads: List[threading.Thread] = []
         self._watch_socks: List[socket.socket] = []
         self._closed = False
@@ -327,13 +348,18 @@ class RemoteClusterStore:
             if self._topo_checked:
                 return
             eps: List[tuple] = []
+            raw: List[str] = []
             n = 1
-            if self.direct_routing and self._ssl_ctx is None:
+            if (self.direct_routing or self.read_from_replicas) \
+                    and self._ssl_ctx is None:
                 try:
                     resp = self._request({"op": "topology"})
                     n = int(resp.get("n_shards", 1))
                     raw = resp.get("endpoints") or []
-                    if n > 1 and len(raw) == n:
+                    with self._lock:
+                        self._read_endpoints = \
+                            resp.get("read_endpoints") or []
+                    if self.direct_routing and n > 1 and len(raw) == n:
                         for addr in raw:
                             host, _, port = addr.rpartition(":")
                             eps.append((host or "127.0.0.1", int(port)))
@@ -343,7 +369,7 @@ class RemoteClusterStore:
                 self._n_shards = n
                 self._shard_endpoints = eps
                 log.info("store topology: %d shards, direct routing to "
-                         "%s", n, resp.get("endpoints"))
+                         "%s", n, raw)
             self._topo_checked = True
 
     def _endpoint_for(self, kind: str, key: str) -> Optional[tuple]:
@@ -440,7 +466,100 @@ class RemoteClusterStore:
                 continue
             if not resp.get("ok"):
                 raise_remote(resp)
+            if payload.get("op") in _MUTATING_WIRE_OPS:
+                self._note_applied(resp.get("applied_rv"))
             return resp
+
+    def _note_applied(self, applied) -> None:
+        """Fold a mutation response's applied_rv stamp into this
+        client's high-water mark (see applied_hwm)."""
+        if applied is None:
+            return
+        with self._lock:
+            if isinstance(applied, dict):
+                self._applied_hwm_mapform = True
+                for sh, rv in applied.items():
+                    if int(rv) > self._applied_hwm.get(str(sh), 0):
+                        self._applied_hwm[str(sh)] = int(rv)
+            elif int(applied) > self._applied_hwm.get("0", 0):
+                self._applied_hwm["0"] = int(applied)
+
+    def applied_hwm(self):
+        """The rv high-water mark across this client's own acked
+        mutations: the ``min_rv`` a read-your-writes read against a
+        replica must demand. Scalar against an unsharded primary,
+        ``{shard: rv}`` once any stamp arrived in map form; None before
+        the first stamped mutation."""
+        with self._lock:
+            if not self._applied_hwm:
+                return None
+            if not self._applied_hwm_mapform:
+                return self._applied_hwm.get("0")
+            return dict(self._applied_hwm)
+
+    # -- read-tier routing ---------------------------------------------------
+
+    def _read_tier_client(self) -> Optional["RemoteClusterStore"]:
+        """The nested client for the preferred (deepest announced)
+        read-tier endpoint, built lazily from topology; None when the
+        tier is disabled, undiscovered, or cooling down after a
+        failure."""
+        if not self.read_from_replicas:
+            return None
+        self._ensure_topology()
+        with self._lock:
+            if self._read_client is not None:
+                return self._read_client
+            if not self._read_endpoints \
+                    or time.monotonic() < self._read_cooldown:
+                return None
+            ep = max(self._read_endpoints,
+                     key=lambda e: int(e.get("depth", 1)))
+            self._read_client = RemoteClusterStore(
+                str(ep["endpoint"]), token=self.token,
+                connect_timeout=self.connect_timeout,
+                direct_routing=False, retry_attempts=1,
+                retry_budget=self.retry_budget)
+            return self._read_client
+
+    def _read_request(self, payload: dict, fallback=None) -> dict:
+        """Route one idempotent read to the read tier, demanding this
+        client's own applied hwm via ``min_rv`` (read-your-writes
+        holds even though the answer comes from a replica). Falls back
+        to the primary on ReplicaLagError or an unreachable replica;
+        other typed errors (NotFoundError, ...) are real answers and
+        propagate."""
+        from .store import ReplicaLagError
+        fb = fallback if fallback is not None \
+            else (lambda: self._request(payload))
+        client = self._read_tier_client()
+        if client is None:
+            return fb()
+        p = dict(payload)
+        if p.get("min_rv") is None:
+            hwm = self.applied_hwm()
+            if hwm is not None:
+                p["min_rv"] = hwm
+        try:
+            resp = client._request(p)
+        except (ReplicaLagError, ConnectionError, OSError) as e:
+            with self._lock:
+                self.read_tier_fallbacks += 1
+                if not isinstance(e, ReplicaLagError):
+                    # unreachable (a lagging replica is still alive):
+                    # drop the client, cool down, rediscover later
+                    dead, self._read_client = self._read_client, None
+                    self._read_cooldown = time.monotonic() + 5.0
+                else:
+                    dead = None
+            if dead is not None:
+                dead.close()
+            log.warning("read-tier request failed (%s: %s); falling "
+                        "back to the primary", type(e).__name__, e)
+            return fb()
+        with self._lock:
+            self.read_tier_reads += 1
+        return resp
 
     def _request_once(self, payload: dict,
                       endpoint: Optional[tuple] = None) -> dict:
@@ -463,7 +582,9 @@ class RemoteClusterStore:
         # pool_size (default 1 — the historical one-socket serialization).
         op = payload.get("op")
         idempotent = op in ("get", "list", "ping", "store_info",
-                            "bootstrap", "topology", "fence_check")
+                            "bootstrap", "topology", "fence_check",
+                            "replica_info", "admission_info",
+                            "announce_read_endpoint")
         conditional = op in ("create", "delete") or (
             op in ("update", "apply")
             and bool(((payload.get("obj") or {}).get("f") or {})
@@ -518,6 +639,10 @@ class RemoteClusterStore:
     def close(self) -> None:
         self._closed = True
         self._stop_event.set()  # wake any backoff sleep immediately
+        with self._lock:
+            rc, self._read_client = self._read_client, None
+        if rc is not None:
+            rc.close()
         with self._pool_cv:
             conns = list(self._conns)
             self._conns.clear()
@@ -629,12 +754,20 @@ class RemoteClusterStore:
             i = j
         return results
 
-    def get(self, kind: str, name: str, namespace: Optional[str] = None):
+    def get(self, kind: str, name: str, namespace: Optional[str] = None,
+            min_rv=None, wait_s: Optional[float] = None):
         key = f"{namespace}/{name}" if namespace is not None else name
-        return decode(self._routed_request(
-            kind, key,
-            {"op": "get", "kind": kind, "name": name,
-             "namespace": namespace})["obj"])
+        payload = {"op": "get", "kind": kind, "name": name,
+                   "namespace": namespace}
+        if min_rv is not None:
+            payload["min_rv"] = min_rv
+            if wait_s is not None:
+                payload["wait_s"] = wait_s
+        if self.read_from_replicas:
+            return decode(self._read_request(
+                payload,
+                lambda: self._routed_request(kind, key, payload))["obj"])
+        return decode(self._routed_request(kind, key, payload)["obj"])
 
     def try_get(self, kind: str, name: str, namespace: Optional[str] = None):
         from .store import NotFoundError
@@ -688,7 +821,8 @@ class RemoteClusterStore:
         applied = None
         resp = None
         for attempt in range(self.retry_attempts + 1):
-            resp = self._request(payload)
+            resp = (self._read_request(payload)
+                    if self.read_from_replicas else self._request(payload))
             applied = resp.get("applied_rv")
             if not self._behind_stream(kind, applied):
                 break

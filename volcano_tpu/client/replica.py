@@ -52,11 +52,30 @@ into a mirrored shard layout, served through the router handler
 (events carry shard tags, resume marks stay per-shard maps);
 ``applied_rv``/``min_rv`` generalize to ``{shard: rv}`` maps.
 
+Fan-out trees (ROADMAP item 1): a replica is a composable TIER, not a
+leaf. Each mirror shard keeps a bounded ring of the raw records it
+applied and exposes the same ship interface the durable store does
+(``ship_floor``/``add_ship_listener``/``newest_snapshot_state``), so a
+replica SERVES ``ship`` and ``bootstrap`` to deeper replicas: a
+depth-2 replica tails a depth-1 replica with byte-identical mirrors
+(the relayed records carry the primary's dense rv stamps unchanged, so
+downstream gap detection works exactly as against the primary), and a
+mid-tree re-bootstrap is answered from the parent's mirror state —
+the primary never hears about it. ``serve()`` announces this endpoint
+up the chain (``announce_read_endpoint``), so the primary's
+``topology`` response grows a ``read_endpoints`` table direct-routing
+clients use to prefer the nearest replica for reads.
+
 Fault points: ``replica_apply`` (fires before each record applies; an
 armed firing DROPS the record — the continuity check detects the hole
-at the next record) and ``replica_apply_dup`` (fires after; an armed
-firing applies the record a second time — detected immediately).
-``wal_ship`` lives on the primary's send seam (client/server.py).
+at the next record), ``replica_apply_dup`` (fires after; an armed
+firing applies the record a second time — detected immediately), and
+``replica_stale_read`` (fires at the head of every ``min_rv`` wait; an
+armed firing expires the block typed — ReplicaLagError — without
+waiting). ``wal_ship`` lives on the primary's send seam
+(client/server.py); a REPLICA serving ship fires ``ship_relay`` there
+instead, so chaos can drop a relayed frame mid-tree without touching
+the primary's streams.
 """
 
 from __future__ import annotations
@@ -72,7 +91,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..resilience.faultinject import FaultError, faults
-from .codec import decode
+from .codec import decode, encode
 from .remote import RemoteClusterStore
 from .server import (
     MAX_FRAME_BYTES, EventJournal, StoreServer, _Handler, recv_exact,
@@ -90,6 +109,11 @@ _MUTATING_OPS = ("create", "update", "apply", "delete", "bulk_apply")
 DEFAULT_LIST_WAIT_S = 5.0
 #: tailer reconnect backoff cap (same shape as the watch-resume path)
 TAIL_BACKOFF_CAP_S = 2.0
+#: applied records a mirror shard retains for re-shipping downstream
+#: (the replica-tier analog of the primary's retained WAL segments);
+#: a child below the ring's floor re-bootstraps from THIS replica's
+#: mirror state, never from the primary
+SHIP_RING_CAPACITY = 4096
 
 _READONLY = ("replica is read-only: writes (and fencing/lease/"
              "conditional-update arbitration) belong to the primary")
@@ -107,15 +131,37 @@ class _ReplicaShard(ClusterStore):
     a ClusterStore that is written ONLY by ``apply_record`` (preserving
     the primary's rv stamps exactly) and whose mutating surface fails
     closed. Watch listeners, the resume journal and list/get all work
-    against it unchanged."""
+    against it unchanged.
+
+    The mirror is also a SHIP SOURCE (fan-out trees): it retains the
+    raw records it applied in a bounded ring and exposes the durable
+    store's ship interface, so server._serve_ship re-serves this
+    lineage to deeper replicas and ``bootstrap`` is answered from the
+    mirror state itself (always complete at the applied rv — unlike
+    the primary's newest-on-disk snapshot, it can never be behind a
+    compaction)."""
+
+    #: this mirror can feed a deeper replica (see server._ship_source)
+    ship_capable = True
+
+    def __init__(self):
+        super().__init__()
+        #: raw shipped record dicts at rv in (_ship_floor_rv, _rv],
+        #: appended under self._lock at the apply commit point
+        self._ship_ring: "collections.deque" = collections.deque()
+        self._ship_floor_rv = 0
+        self._ship_listeners: List = []
 
     # -- the only write path ------------------------------------------------
 
-    def apply_record(self, rv: int, kind: str, event: str, obj) -> None:
+    def apply_record(self, rv: int, kind: str, event: str, obj,
+                     rec: Optional[dict] = None) -> None:
         """Apply one shipped WAL record. Refuses (ReplicaGapError) any
         record that does not extend the applied rv by exactly one —
         WAL rvs are dense, so a jump is a lost record and a repeat is a
-        duplicate, and neither may be absorbed silently."""
+        duplicate, and neither may be absorbed silently. ``rec`` is the
+        raw wire record: when given it enters the re-ship ring and
+        fires downstream ship listeners, atomically with the apply."""
         rv = int(rv)
         with self._lock:
             if rv != self._rv + 1:
@@ -135,6 +181,19 @@ class _ReplicaShard(ClusterStore):
             self._notify(kind, event, obj,
                          (old if old is not None else obj)
                          if event == "update" else None)
+            if rec is not None:
+                self._relay(rec)
+
+    def _relay(self, rec: dict) -> None:
+        # under self._lock (the apply commit point): ring append +
+        # listener fire are atomic with respect to _serve_ship's
+        # registration hold, so no record can fall between a child's
+        # ring replay and its live tail
+        self._ship_ring.append(rec)
+        if len(self._ship_ring) > SHIP_RING_CAPACITY:
+            self._ship_floor_rv = int(self._ship_ring.popleft()["rv"])
+        for fn in list(self._ship_listeners):
+            fn(rec)
 
     def load_state(self, rv: int, state: Optional[dict]) -> None:
         """Replace the mirror with a bootstrap snapshot (state may be
@@ -155,6 +214,51 @@ class _ReplicaShard(ClusterStore):
                 for kind, krv in state["kind_rv"].items():
                     self._kind_rv[kind] = int(krv)
             self._rv = int(rv)
+            # the re-ship window restarts at the snapshot: a child below
+            # this floor re-bootstraps from THIS mirror's state (above),
+            # never from the primary
+            self._ship_ring.clear()
+            self._ship_floor_rv = self._rv
+
+    # -- the ship interface (mirror as a ship source) -----------------------
+
+    def ship_floor(self) -> int:
+        """Oldest rv the ring can resume from (exclusive). Same contract
+        as the durable store's retained-segment floor."""
+        with self._lock:
+            return self._ship_floor_rv
+
+    def ship_records(self, since_rv: int, live_to: int) -> List[dict]:
+        """Ring records with since_rv < rv <= live_to. Caller holds the
+        shard lock (server._serve_ship's registration hold)."""
+        return [r for r in self._ship_ring
+                if since_rv < int(r["rv"]) <= live_to]
+
+    def add_ship_listener(self, fn) -> None:
+        with self._lock:
+            self._ship_listeners.append(fn)
+
+    def remove_ship_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._ship_listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def newest_snapshot_state(self):
+        """Bootstrap source for a downstream replica: the mirror state
+        itself, complete at the applied rv by construction (no snapshot
+        cadence to lag behind)."""
+        with self._lock:
+            if self._rv == 0:
+                return 0, None
+            state = {
+                "rv": self._rv,
+                "kind_rv": dict(self._kind_rv),
+                "buckets": {k: [encode(o) for o in b.values()]
+                            for k, b in self._buckets.items()},
+            }
+            return self._rv, state
 
     # -- mutations fail closed ----------------------------------------------
 
@@ -213,12 +317,36 @@ class _ReplicaHandler(_Handler):
         if op in _MUTATING_OPS:
             raise ReplicaReadOnlyError(
                 f"{_READONLY} (primary: {replica.primary_address})")
-        if op == "list":
+        if op in ("list", "get"):
             min_rv = req.get("min_rv")
             if min_rv is not None:
                 replica.wait_applied(
                     min_rv, float(req.get("wait_s", DEFAULT_LIST_WAIT_S)))
             return _Handler._dispatch(self, store, op, req)
+        if op == "store_info":
+            resp = _Handler._dispatch(self, store, op, req)
+            # a replica IS a valid ship source: a deeper replica's
+            # handshake passes the same check the durable primary does
+            resp["ship_capable"] = True
+            resp["depth"] = replica.depth
+            resp["upstream"] = replica.primary_address
+            return resp
+        if op == "bootstrap":
+            replica.ship_served["bootstraps"] += 1
+            try:
+                from ..metrics import metrics
+                metrics.replica_ship_served_bootstraps_total.inc()
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+            return _Handler._dispatch(self, store, op, req)
+        if op == "replica_info":
+            return replica.info()
+        if op == "announce_read_endpoint":
+            resp = _Handler._dispatch(self, store, op, req)
+            # relay up the chain so the PRIMARY's topology table learns
+            # about endpoints announced anywhere in the tree
+            replica._announce_upstream(req)
+            return resp
         return _Handler._dispatch(self, store, op, req)
 
     def _serve_watch(self, sock, store, req) -> None:
@@ -255,6 +383,9 @@ class ReplicaServer(StoreServer):
                          tls_client_ca=tls_client_ca, gate=gate)
         self.replica = replica
         self._server.replica = replica  # type: ignore[attr-defined]
+        # a replica relaying ship fires its own chaos seam, so a test
+        # can drop a mid-tree frame without touching primary streams
+        self._server.ship_fault_point = "ship_relay"  # type: ignore
 
     def on_rebootstrap(self, shard_idx: Optional[int]) -> None:
         self.journal.close()
@@ -293,6 +424,7 @@ class ShardedReplicaServer(ShardRouter):
                          tls_client_ca=tls_client_ca, gate=gate)
         self.replica = replica
         self._server.replica = replica  # type: ignore[attr-defined]
+        self._server.ship_fault_point = "ship_relay"  # type: ignore
 
     def on_rebootstrap(self, shard_idx: Optional[int]) -> None:
         # only the re-bootstrapped shard's journal restarts from the new
@@ -342,16 +474,22 @@ class ReplicaStore:
             tls_ca=tls_ca, tls_cert=tls_cert, tls_key=tls_key,
             retry_attempts=8, retry_cap_s=2.0)
         info = self._client._request({"op": "store_info"})
-        if not info.get("durable"):
+        if not (info.get("durable") or info.get("ship_capable")):
             raise RuntimeError(
                 f"primary {primary} is not durable (no --store-data-dir): "
                 "there is no WAL to ship, so it cannot feed a replica")
         self.n_shards = int(info.get("shards", 1))
+        #: 1 when tailing the primary, parent depth + 1 down a tree
+        self.depth = int(info.get("depth", 0) or 0) + 1
         self.store = (_ReplicaShard() if self.n_shards == 1
                       else _ReplicaShardedStore(self.n_shards))
         self.server: Optional[StoreServer] = None
         #: re/bootstrap count per reason (initial/out_of_window/apply_gap)
         self.bootstraps: "collections.Counter" = collections.Counter()
+        #: ship/bootstrap traffic THIS replica absorbed for its children
+        #: (streams/records/bootstraps — the primary never sees it)
+        self.ship_served: "collections.Counter" = collections.Counter()
+        self._ship_streams = 0
         #: last primary rv seen on each shard's ship stream (lag floor)
         self.primary_rv: Dict[int, int] = {}
         self.ship_bytes = 0
@@ -362,6 +500,11 @@ class ReplicaStore:
         self._sock_lock = threading.Lock()
         self._watchers = 0
         self._last_applied_ts: Dict[int, float] = {}
+        try:
+            from ..metrics import metrics
+            metrics.replica_upstream_depth.set(self.depth)
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
         for idx in range(self.n_shards):
             self._bootstrap(idx, "initial")
 
@@ -396,6 +539,14 @@ class ReplicaStore:
     def wait_applied(self, min_rv, wait_s: float = DEFAULT_LIST_WAIT_S):
         """Block until the mirror has applied ``min_rv`` (scalar, or
         ``{shard: rv}``); raise ReplicaLagError past ``wait_s``."""
+        try:
+            faults.fire("replica_stale_read")
+        except FaultError:
+            # injected staleness: the block expires typed immediately,
+            # driving the caller's primary-fallback path
+            raise ReplicaLagError(
+                f"injected stale read: replica at applied_rv "
+                f"{self.applied_rv()} refused min_rv {min_rv}")
         deadline = time.monotonic() + float(wait_s)
         with self._cv:
             while not self._covers(min_rv):
@@ -417,7 +568,30 @@ class ReplicaStore:
         self.server = cls(self, host=host, port=port, token=token,
                           tls_cert=tls_cert, tls_key=tls_key,
                           tls_client_ca=tls_client_ca, gate=gate).start()
+        self._announce_self()
         return self.server
+
+    def _announce_self(self) -> None:
+        """Best-effort: register this read endpoint up the chain so the
+        primary's ``topology`` table can hand it to direct-routing
+        clients. Discovery is advisory — a failed announce degrades to
+        clients reading the primary, never to an error."""
+        if self.server is None:
+            return
+        self._announce_upstream({"endpoint": self.server.address,
+                                 "depth": self.depth,
+                                 "shards": self.n_shards})
+
+    def _announce_upstream(self, req: dict) -> None:
+        try:
+            self._client._request({
+                "op": "announce_read_endpoint",
+                "endpoint": req["endpoint"],
+                "depth": int(req.get("depth", 1)),
+                "shards": int(req.get("shards", 1))})
+        except Exception:  # noqa: BLE001 — discovery is advisory
+            log.debug("announce_read_endpoint upstream failed",
+                      exc_info=True)
 
     def start(self) -> "ReplicaStore":
         for idx in range(self.n_shards):
@@ -554,7 +728,7 @@ class ReplicaStore:
                     # mirror; the next record's continuity check refuses
                     continue
                 shard.apply_record(rec["rv"], rec["kind"], rec["event"],
-                                   decode(rec["obj"]))
+                                   decode(rec["obj"]), rec=rec)
                 ts = rec.get("ts")
                 if ts is not None:
                     self._last_applied_ts[idx] = float(ts)
@@ -575,12 +749,47 @@ class ReplicaStore:
             return None
         return max(0, prv - self._shard(idx)._rv)
 
+    def lag_seconds(self, idx: int = 0) -> Optional[float]:
+        lag = self.lag_records(idx)
+        if lag == 0:
+            return 0.0
+        ts = self._last_applied_ts.get(idx)
+        if lag is None or ts is None:
+            return None
+        return max(0.0, time.time() - ts)
+
+    def info(self) -> dict:
+        """The ``replica_info`` wire response: this hop's place in the
+        tree, its lag, and the ship traffic it absorbed downstream.
+        vcctl walks ``upstream`` hop by hop to print the chain."""
+        per_shard = {}
+        for idx in range(self.n_shards):
+            per_shard[str(idx)] = {
+                "applied_rv": self._shard(idx)._rv,
+                "lag_records": self.lag_records(idx),
+                "lag_seconds": self.lag_seconds(idx),
+            }
+        return {
+            "ok": True,
+            "upstream": self.primary_address,
+            "depth": self.depth,
+            "shards": self.n_shards,
+            "applied_rv": self.applied_rv(),
+            "per_shard": per_shard,
+            "bootstraps": dict(self.bootstraps),
+            "watchers": self._watchers,
+            "ship_served": dict(self.ship_served),
+        }
+
     def _export_lag(self, idx: int, nbytes: int) -> None:
         try:
             from ..metrics import metrics
             labels = {"shard": str(idx)}
             applied = self._shard(idx)._rv
             metrics.replica_applied_rv.set(applied, labels=labels)
+            prv = self.primary_rv.get(idx)
+            if prv is not None:
+                metrics.replica_upstream_rv.set(prv, labels=labels)
             lag = self.lag_records(idx)
             if lag is not None:
                 metrics.replica_lag_records.set(lag, labels=labels)
@@ -600,5 +809,15 @@ class ReplicaStore:
         try:
             from ..metrics import metrics
             metrics.replica_watchers.set(n)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _ship_stream_delta(self, d: int) -> None:
+        with self._cv:
+            self._ship_streams += d
+            n = self._ship_streams
+        try:
+            from ..metrics import metrics
+            metrics.replica_ship_served_streams.set(n)
         except Exception:  # noqa: BLE001
             pass

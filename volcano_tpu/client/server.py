@@ -85,11 +85,13 @@ def applied_rv_of(store) -> object:
 
 
 def _ship_source(store, shard) -> "ClusterStore":
-    """Resolve a ship/bootstrap request to the durable store that owns
-    the WAL lineage: the store itself, or — behind a ShardRouter — the
-    requested member shard. Refuses non-durable stores: a replica can
-    only follow a primary with segments to ship (and a replica's own
-    backing store is never durable, so chained replicas refuse here)."""
+    """Resolve a ship/bootstrap request to the store that owns the WAL
+    lineage: the store itself, or — behind a ShardRouter — the requested
+    member shard. Any ``ship_capable`` store qualifies: the durable
+    primary (disk segments + live tail) or a replica's mirror shard
+    (bounded re-ship ring + live tail) — fan-out trees hang replicas
+    off replicas through exactly this seam. A plain in-memory store has
+    no lineage to ship and refuses."""
     shards = getattr(store, "shards", None)
     idx = int(shard or 0)
     if shards is None:
@@ -101,7 +103,8 @@ def _ship_source(store, shard) -> "ClusterStore":
             raise RuntimeError(
                 f"shard {idx} out of range (store has {len(shards)})")
         target = store._shard(idx)  # ShardUnavailableError when down
-    if getattr(target, "data_dir", None) is None:
+    if (getattr(target, "data_dir", None) is None
+            and not getattr(target, "ship_capable", False)):
         raise RuntimeError(
             "replica bootstrap/ship requires a durable primary "
             "(--store-data-dir): an in-memory store has no WAL to ship")
@@ -495,6 +498,12 @@ class _Handler(socketserver.BaseRequestHandler):
             while True:
                 req = recv_frame(sock)
                 op = req.get("op")
+                # per-op request counters (store_info "requests"): the
+                # ground truth for "the primary served zero read-lane
+                # traffic while the tree absorbed the storm"
+                counts = getattr(self.server, "op_counts", None)
+                if counts is not None and op:
+                    counts[op] += 1
                 if op in ("watch", "bulk_watch", "ship"):
                     # stream setup admits through the gate too: a storm
                     # of new watchers queues/sheds at its lane instead
@@ -596,11 +605,13 @@ class _Handler(socketserver.BaseRequestHandler):
         if op in ("create", "update", "apply"):
             obj = getattr(store, op)(kind, decode(req["obj"]),
                                      fencing=fencing)
-            return {"ok": True, "obj": encode(obj)}
+            return {"ok": True, "obj": encode(obj),
+                    "applied_rv": self._applied_stamp(store)}
         if op == "delete":
             obj = store.delete(kind, req["name"], req.get("namespace"),
                                fencing=fencing)
-            return {"ok": True, "obj": encode(obj)}
+            return {"ok": True, "obj": encode(obj),
+                    "applied_rv": self._applied_stamp(store)}
         if op == "bulk_apply":
             # one frame, many objects, one journal batch (the durable
             # store fsyncs once for the wave); per-item results so one
@@ -616,7 +627,8 @@ class _Handler(socketserver.BaseRequestHandler):
                                    "message": str(r)}
                           for i, r in enumerate(results)
                           if isinstance(r, Exception)}
-                return {"ok": True, "n": len(results), "errors": errors}
+                return {"ok": True, "n": len(results), "errors": errors,
+                        "applied_rv": self._applied_stamp(store)}
             out = []
             for res in results:
                 if isinstance(res, Exception):
@@ -624,7 +636,8 @@ class _Handler(socketserver.BaseRequestHandler):
                                 "message": str(res)})
                 else:
                     out.append({"obj": encode(res)})
-            return {"ok": True, "results": out}
+            return {"ok": True, "results": out,
+                    "applied_rv": self._applied_stamp(store)}
         if op == "get":
             with store.locked():
                 rv = applied_rv_of(store)
@@ -653,10 +666,15 @@ class _Handler(socketserver.BaseRequestHandler):
             shards = getattr(store, "shards", None)
             with store.locked():
                 rv = applied_rv_of(store)
+            counts = getattr(self.server, "op_counts", None)
             return {"ok": True, "rv": rv,
                     "shards": len(shards) if shards is not None else 1,
                     "durable": getattr(store, "data_dir", None)
                     is not None,
+                    "ship_capable": getattr(store, "data_dir", None)
+                    is not None or bool(getattr(store, "ship_capable",
+                                                False)),
+                    "requests": dict(counts) if counts is not None else {},
                     "recovered": getattr(store, "recovered_records", 0),
                     "pid": _os.getpid()}
         if op == "bootstrap":
@@ -675,11 +693,42 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True}
         if op == "topology":
             return self._topology(store)
+        if op == "announce_read_endpoint":
+            # a replica (possibly deep in a tree) registers itself so
+            # topology can hand read traffic to the read tier; advisory
+            # — clients that never ask keep reading here
+            table = getattr(self.server, "read_endpoints", None)
+            if table is not None and req.get("endpoint"):
+                table[str(req["endpoint"])] = {
+                    "depth": int(req.get("depth", 1)),
+                    "shards": int(req.get("shards", 1)),
+                }
+            return {"ok": True}
         if op == "ping":
             return {"ok": True}
+        if op == "replica_info":
+            # a quiet typed refusal: vcctl probes every hop of an
+            # upstream chain with this op to find where the tree ends,
+            # and hitting the primary is the expected terminal case
+            return {"ok": False, "error": "RuntimeError",
+                    "message": "not a replica endpoint"}
         if op == "auth":
             return {"ok": True}  # token-less server: auth is a no-op
         raise RuntimeError(f"unknown op {op!r}")
+
+    def _applied_stamp(self, store) -> object:
+        """rv(s) as of (at least) this mutation's commit, stamped on the
+        response so the writer can demand read-your-writes from a
+        replica via ``min_rv`` on its next read. A shard WORKER stamps a
+        ``{shard: rv}`` map keyed by its shard tag — the proc router
+        relays worker responses verbatim, and a bare scalar would be
+        ambiguous once it crosses that hop."""
+        with store.locked():
+            rv = applied_rv_of(store)
+        tag = getattr(self.server, "shard_tag", None)
+        if tag is not None and not isinstance(rv, dict):
+            return {str(tag): rv}
+        return rv
 
     def _topology(self, store: ClusterStore) -> dict:
         """The shard map a direct-routing client asks for once: shard
@@ -690,7 +739,12 @@ class _Handler(socketserver.BaseRequestHandler):
         router (client/shardproc.py) overrides with real worker
         endpoints."""
         shards = getattr(store, "n_shards", 1)
-        return {"ok": True, "n_shards": int(shards), "endpoints": []}
+        table = getattr(self.server, "read_endpoints", {}) or {}
+        return {"ok": True, "n_shards": int(shards), "endpoints": [],
+                "read_endpoints": [
+                    {"endpoint": ep, "depth": meta.get("depth", 1),
+                     "shards": meta.get("shards", 1)}
+                    for ep, meta in table.items()]}
 
     def _serve_watch(self, sock: socket.socket, store: ClusterStore,
                      req: dict) -> None:
@@ -865,7 +919,13 @@ class _Handler(socketserver.BaseRequestHandler):
         at every frame send (arm ``exc:`` to drop the link mid-segment,
         ``exc:exit`` to SIGKILL the primary there); the replica's
         record-continuity check is the backstop for anything this stream
-        could lose."""
+        could lose.
+
+        A REPLICA serving this op (fan-out trees) replays from its
+        mirror shard's re-ship ring instead of disk segments, fires the
+        ``ship_relay`` fault point instead of ``wal_ship``, and counts
+        the absorbed traffic in its ``ship_served`` ledger — same
+        protocol, same lock-hold no-gap guarantee, different source."""
         from .durable import _segment_paths, read_frames
         try:
             src = _ship_source(store, req.get("shard"))
@@ -875,6 +935,19 @@ class _Handler(socketserver.BaseRequestHandler):
                               "error": name if name in _ERRORS
                               else "RuntimeError", "message": str(e)})
             return
+        fault_point = getattr(self.server, "ship_fault_point", "wal_ship")
+        replica = getattr(self.server, "replica", None)
+
+        def account(n: int) -> None:
+            if replica is None:
+                return
+            replica.ship_served["records"] += n
+            try:
+                from ..metrics import metrics as _m
+                _m.replica_ship_served_records_total.inc(n)
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+
         since_rv = int(req.get("since_rv", 0))
         events: "queue.Queue" = queue.Queue(maxsize=WATCH_QUEUE_MAX)
         overflowed = threading.Event()
@@ -901,17 +974,28 @@ class _Handler(socketserver.BaseRequestHandler):
             # segments, every record > live_from arrives via the hook —
             # no record can fall between disk replay and live tail
             live_from = src._rv
-            segments = _segment_paths(src.data_dir)
+            if getattr(src, "data_dir", None) is not None:
+                segments = _segment_paths(src.data_dir)
+                pending: Optional[list] = None
+            else:
+                # mirror ship source: the bounded re-ship ring stands in
+                # for disk segments, captured under the SAME lock hold
+                segments = []
+                pending = src.ship_records(since_rv, live_from)
             src.add_ship_listener(on_record)
+        if replica is not None:
+            replica.ship_served["streams"] += 1
+            replica._ship_stream_delta(1)
         try:
             send_frame(sock, {"ok": True, "rv": live_from})
             batch: list = []
 
             def flush() -> None:
                 if batch:
-                    faults.fire("wal_ship")
+                    faults.fire(fault_point)
                     send_frame(sock, {"stream": "wal", "recs": batch,
                                       "prv": live_from})
+                    account(len(batch))
                     del batch[:]
 
             for path in segments:
@@ -921,6 +1005,10 @@ class _Handler(socketserver.BaseRequestHandler):
                         batch.append(rec)
                         if len(batch) >= SHIP_BATCH_MAX:
                             flush()
+            for rec in pending or ():
+                batch.append(rec)
+                if len(batch) >= SHIP_BATCH_MAX:
+                    flush()
             flush()
             send_frame(sock, {"stream": "ship_synced", "rv": live_from})
             while not overflowed.is_set():
@@ -939,9 +1027,10 @@ class _Handler(socketserver.BaseRequestHandler):
                         recs.append(events.get_nowait())
                     except queue.Empty:
                         break
-                faults.fire("wal_ship")
+                faults.fire(fault_point)
                 send_frame(sock, {"stream": "wal", "recs": recs,
                                   "prv": src._rv})
+                account(len(recs))
             log.warning("ship stream overflowed %d records; dropping the "
                         "slow replica (it resumes at its applied rv)",
                         WATCH_QUEUE_MAX)
@@ -952,6 +1041,8 @@ class _Handler(socketserver.BaseRequestHandler):
             pass  # replica went away; it resumes from its applied rv
         finally:
             src.remove_ship_listener(on_record)
+            if replica is not None:
+                replica._ship_stream_delta(-1)
 
 
 class StoreServer:
@@ -1026,6 +1117,11 @@ class StoreServer:
         # (the shard ROUTER serves watches through its hub's per-shard
         # encoders instead — _RouterHandler overrides _serve_watch)
         self._server.delta_enc = DeltaEncoder()  # type: ignore[attr-defined]
+        # per-op request counters (store_info "requests") and the
+        # announced read-tier endpoints (topology "read_endpoints")
+        self._server.op_counts = (  # type: ignore[attr-defined]
+            collections.Counter())
+        self._server.read_endpoints = {}  # type: ignore[attr-defined]
         # live connection sockets, so stop() drops watch streams too
         # (daemon handler threads outlive server_close otherwise and
         # clients would never learn the server is gone)

@@ -945,7 +945,8 @@ class ProcShardedStore:
                 rvs[str(i)] = info.get("rv")
                 recovered += int(info.get("recovered", 0))
             return {"ok": True, "rv": rvs, "shards": self.n_shards,
-                    "durable": durable, "recovered": recovered,
+                    "durable": durable, "ship_capable": durable,
+                    "recovered": recovered,
                     "pid": os.getpid()}
         if op == "topology":
             return self.sup.topology()
@@ -1161,7 +1162,22 @@ class _ProcRouterHandler(_Handler):
                     workers[str(i)] = None
             resp["workers"] = workers
             return resp
-        return store.dispatch(op, req)
+        if op == "announce_read_endpoint":
+            # the registry lives on the router server (base handler);
+            # workers never see announcements
+            return _Handler._dispatch(self, store, op, req)
+        resp = store.dispatch(op, req)
+        if op == "topology" and resp.get("ok"):
+            # merge the announced read tier into the worker endpoint map
+            table = getattr(self.server, "read_endpoints", {}) or {}
+            resp["read_endpoints"] = [
+                {"endpoint": ep, "depth": meta.get("depth", 1),
+                 "shards": meta.get("shards", 1)}
+                for ep, meta in table.items()]
+        elif op == "store_info" and resp.get("ok"):
+            counts = getattr(self.server, "op_counts", None)
+            resp["requests"] = dict(counts) if counts is not None else {}
+        return resp
 
     def _serve_watch(self, sock: socket.socket, store: ProcShardedStore,
                      req: dict) -> None:

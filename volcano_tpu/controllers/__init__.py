@@ -46,11 +46,20 @@ class ControllerManager:
     N worker threads partitioned by the job key's store shard, so
     pod-wave ingest overlaps store round trips instead of queueing
     behind one request at a time (pair with the store client's
-    ``pool_size``)."""
+    ``pool_size``).
+    ``read_store=`` moves the controllers onto the read tier (ROADMAP
+    item 1): list/watch/bulk_watch are served by that replica surface
+    while every mutation keeps flowing to ``cluster`` (the primary,
+    fencing untouched), with read-your-writes held via the min_rv
+    bound — see client.readtier.ReadTierStore."""
 
     def __init__(self, cluster, scheduler_name: str = "volcano",
                  default_queue: str = "default", worker_num: int = 3,
-                 shard_workers: int = 1, bulk_watch: bool = False):
+                 shard_workers: int = 1, bulk_watch: bool = False,
+                 read_store=None):
+        if read_store is not None:
+            from ..client.readtier import ReadTierStore
+            cluster = ReadTierStore(cluster, read_store)
         self.opt = ControllerOption(cluster=cluster,
                                     scheduler_name=scheduler_name,
                                     default_queue=default_queue,
@@ -111,8 +120,11 @@ class ControllerManager:
         import threading
         from ..utils import LeaderElector, LeaseLock
 
+        # lease arbitration always runs against the primary: a standby's
+        # takeover decision must never ride a replica's staleness
+        write = getattr(self.opt.cluster, "write_store", self.opt.cluster)
         elector = LeaderElector(
-            LeaseLock(self.opt.cluster, lock_name), identity=identity)
+            LeaseLock(write, lock_name), identity=identity)
         self._elector = elector
         # fencing: each controller's writes (pod create/delete, job and
         # podgroup status) carry this manager's lease token, so a deposed

@@ -579,6 +579,27 @@ replica_ship_bytes_total = registry.register(Counter(
 replica_watchers = registry.register(Gauge(
     "volcano_replica_watchers",
     "Watch/bulk_watch streams currently served by this replica"))
+replica_upstream_depth = registry.register(Gauge(
+    "volcano_replica_upstream_depth",
+    "This replica's depth in the fan-out tree: 1 tails the primary "
+    "directly, N tails a depth-(N-1) replica"))
+replica_upstream_rv = registry.register(Gauge(
+    "volcano_replica_upstream_rv",
+    "Newest upstream resource_version seen on this replica's ship "
+    "stream(s), per lineage — the rv its lag is measured against",
+    ["shard"]))
+replica_ship_served_streams = registry.register(Gauge(
+    "volcano_replica_ship_served_streams",
+    "Downstream ship streams this replica is currently re-serving "
+    "(its children in the fan-out tree)"))
+replica_ship_served_records_total = registry.register(Counter(
+    "volcano_replica_ship_served_records_total",
+    "WAL records this replica relayed to downstream replicas — "
+    "traffic the primary never saw"))
+replica_ship_served_bootstraps_total = registry.register(Counter(
+    "volcano_replica_ship_served_bootstraps_total",
+    "Bootstrap requests this replica answered from its own mirror "
+    "state (mid-tree re-bootstraps that never touched the primary)"))
 
 # -- global rescheduler metrics (reschedule/) -------------------------------
 

@@ -118,7 +118,16 @@ and ``delta_frame_dup`` (same seam, after the enqueue — an armed
 firing enqueues the frame a SECOND time; the repeated ``ks`` is
 refused immediately, same typed fallback, zero duplicated events;
 object-form streams never pass this seam, so the blast radius is
-exactly the delta dialect).
+exactly the delta dialect), ``ship_relay`` (client/server.py
+_serve_ship when the ship SOURCE is itself a replica mirror — same
+frame-send seam as ``wal_ship`` but only for relayed streams, so a
+mid-TREE link can be cut without touching the primary's own shipping:
+the downstream child resumes at a record boundary from its PARENT and
+the primary's request counters stay flat), and ``replica_stale_read``
+(client/replica.py ReplicaStore.wait_applied, before the bounded wait
+— an armed firing refuses the read typed with ReplicaLagError exactly
+as if the ``min_rv`` block had expired, driving the client's
+fall-back-to-primary ladder deterministically).
 """
 
 from __future__ import annotations

@@ -545,8 +545,14 @@ class Scheduler:
             LEASE_DURATION, RENEW_DEADLINE, RETRY_PERIOD,
         )
 
+        # a read-tiered cache cluster (client.readtier.ReadTierStore)
+        # still arbitrates its lease — and replays the dead leader's
+        # intents — against the PRIMARY: takeover truth never rides a
+        # replica's staleness
+        write = getattr(self.cache.cluster, "write_store",
+                        self.cache.cluster)
         elector = LeaderElector(
-            LeaseLock(self.cache.cluster, lock_name), identity=identity,
+            LeaseLock(write, lock_name), identity=identity,
             lease_duration=lease_duration or LEASE_DURATION,
             renew_deadline=renew_deadline or RENEW_DEADLINE,
             retry_period=retry_period or RETRY_PERIOD)
@@ -572,10 +578,10 @@ class Scheduler:
                     # its in-flight migration waves (reschedule/intent.py:
                     # swallowed evictions are ABANDONED, never re-driven)
                     try:
-                        reconcile_bind_intents(self.cache.cluster,
+                        reconcile_bind_intents(write,
                                                elector.fencing_token)
                         from .reschedule import reconcile_migration_intents
-                        reconcile_migration_intents(self.cache.cluster,
+                        reconcile_migration_intents(write,
                                                     elector.fencing_token)
                     except Exception:
                         log.exception("bind/migration-intent recovery "

@@ -67,7 +67,8 @@ class Standalone:
                  store_shard_procs: bool = False,
                  controller_shard_workers: int = 1,
                  admission_lanes: Optional[str] = None,
-                 admission_queue_wait_ms: Optional[float] = None):
+                 admission_queue_wait_ms: Optional[float] = None,
+                 controllers_read_endpoint: Optional[str] = None):
         from .cache import SchedulerCache
         from .client import ClusterStore
         from .controllers import ControllerManager
@@ -293,10 +294,25 @@ class Standalone:
         if self._shard_supervisor is not None:
             from .resilience.overload import LaneStore
             ctrl_store = LaneStore(self.store, "control")
+        # --controllers-read-endpoint: serve the controllers' steady-
+        # state reads (list/watch/bulk_watch) from a replica endpoint
+        # while their mutations keep flowing here (ROADMAP item 1);
+        # read-your-writes holds via the min_rv bound (client/readtier)
+        self._controllers_read_client = None
+        ctrl_read = None
+        if controllers_read_endpoint:
+            from .client import RemoteClusterStore
+            self._controllers_read_client = RemoteClusterStore(
+                controllers_read_endpoint,
+                token=store_token if store_token is not None
+                else os.environ.get("VOLCANO_STORE_TOKEN", ""),
+                direct_routing=False)
+            ctrl_read = self._controllers_read_client
         self.controllers = ControllerManager(
             ctrl_store, scheduler_name=scheduler_name,
             default_queue=default_queue,
-            shard_workers=controller_shard_workers)
+            shard_workers=controller_shard_workers,
+            read_store=ctrl_read)
         self.controllers.run()
         self.scheduler = Scheduler(
             self.cache, scheduler_conf=scheduler_conf, period=period,
@@ -391,6 +407,8 @@ class Standalone:
             self._shard_supervisor.stop()
         if self.webhook_server is not None:
             self.webhook_server.shutdown()
+        if self._controllers_read_client is not None:
+            self._controllers_read_client.close()
         close = getattr(self.store, "close", None)
         if close is not None:
             close()  # flush + fsync the WAL (recovery never depends on it)
@@ -562,6 +580,15 @@ def main(argv=None) -> int:
                          "typed OverloadedError + retry-after hint "
                          "(default 2000; requests carrying a tighter "
                          "wire deadline_ms shed at that instead)")
+    ap.add_argument("--controllers-read-endpoint", metavar="HOST:PORT",
+                    dest="controllers_read_endpoint",
+                    help="serve the controllers' list/watch/bulk_watch "
+                         "from the replica at HOST:PORT (any depth in a "
+                         "fan-out tree) while their mutations keep "
+                         "flowing to this process's store; read-your-"
+                         "writes holds via the min_rv bound, and a "
+                         "lagging/unreachable replica degrades reads "
+                         "back to the primary, typed and counted")
     ap.add_argument("--controller-shard-workers", type=int, default=1,
                     metavar="N",
                     help="fan the job controller's sync drain out "
@@ -708,7 +735,8 @@ def main(argv=None) -> int:
                     store_shard_procs=args.store_shard_procs,
                     controller_shard_workers=args.controller_shard_workers,
                     admission_lanes=args.admission_lanes,
-                    admission_queue_wait_ms=args.admission_queue_wait_ms)
+                    admission_queue_wait_ms=args.admission_queue_wait_ms,
+                    controllers_read_endpoint=args.controllers_read_endpoint)
     if args.jobs_dir:
         import glob
         import os
